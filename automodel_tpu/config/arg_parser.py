@@ -1,0 +1,61 @@
+"""CLI override grammar: ``--config path`` plus dotted-path overrides.
+
+Reference parity: ``nemo_automodel/components/config/_arg_parser.py:20-91``.
+Grammar: ``--dotted.path value``, ``--key=value``, bare ``--flag`` -> True.
+Values run through :func:`translate_value` so ``--optimizer.lr 1e-4`` lands as
+a float and ``--model.layers [1,2]`` as a list.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional, Sequence, Tuple
+
+from automodel_tpu.config.loader import (
+    ConfigNode,
+    _resolve_fn_keys,
+    load_yaml_config,
+    translate_value,
+)
+
+
+def parse_cli_overrides(argv: Sequence[str]) -> List[Tuple[str, object]]:
+    """Parse ``--a.b.c v`` / ``--a.b=v`` / ``--flag`` tokens into (dotted, value) pairs."""
+    overrides: List[Tuple[str, object]] = []
+    i = 0
+    argv = list(argv)
+    while i < len(argv):
+        tok = argv[i]
+        if not tok.startswith("--"):
+            raise ValueError(f"Unexpected argument {tok!r}; overrides start with --")
+        body = tok[2:]
+        if "=" in body:
+            key, _, raw = body.partition("=")
+            overrides.append((key, translate_value(raw)))
+            i += 1
+        elif i + 1 < len(argv) and not argv[i + 1].startswith("--"):
+            overrides.append((body, translate_value(argv[i + 1])))
+            i += 2
+        else:
+            overrides.append((body, True))
+            i += 1
+    return overrides
+
+
+def parse_args_and_load_config(
+    argv: Optional[Sequence[str]] = None,
+    default_config: Optional[str] = None,
+) -> ConfigNode:
+    """Load ``--config/-c`` YAML and apply dotted CLI overrides on top."""
+    parser = argparse.ArgumentParser(add_help=False)
+    parser.add_argument("--config", "-c", default=default_config)
+    known, rest = parser.parse_known_args(argv)
+    if known.config is None:
+        raise SystemExit("Missing required --config/-c argument")
+    cfg = load_yaml_config(known.config)
+    for dotted, value in parse_cli_overrides(rest):
+        cfg.set_by_dotted(dotted, value)
+    # Re-run *_fn key resolution so e.g. `--dataloader.collate_fn pkg.mod.fn`
+    # arrives as the callable, same as it would from YAML.
+    _resolve_fn_keys(cfg)
+    return cfg

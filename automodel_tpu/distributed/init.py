@@ -1,0 +1,110 @@
+"""Multi-host process bootstrap: TPU replacement for NCCL process-group init.
+
+The reference's ``initialize_distributed``
+(``nemo_automodel/components/distributed/init_utils.py:65-162``) wraps
+``torch.distributed.init_process_group(backend="nccl"|"gloo")`` with single-
+process auto-port fallback and atexit teardown.  On TPU the runtime handles
+collectives (ICI/DCN via XLA); all we must do is call
+``jax.distributed.initialize`` exactly once per host when running multi-host,
+and expose rank/world metadata in the same ``DistInfo`` shape recipes expect.
+"""
+
+from __future__ import annotations
+
+import atexit
+import dataclasses
+import logging
+import os
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_INITIALIZED = False
+
+
+@dataclasses.dataclass
+class DistInfo:
+    """Reference parity: ``distributed/init_utils.py:152-162``."""
+
+    backend: str
+    rank: int            # process index (host rank; one process per host on TPU)
+    world_size: int      # total device count across all hosts
+    local_rank: int
+    num_processes: int   # host count
+    is_main: bool
+
+    @property
+    def device_count(self) -> int:
+        return jax.device_count()
+
+
+def initialize_distributed(
+    backend: str = "xla",
+    timeout_minutes: Optional[float] = None,  # accepted for YAML compat; unused
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **_unused,
+) -> DistInfo:
+    """Initialize multi-host JAX if env says we're multi-host, else no-op.
+
+    Single-process runs (tests, one chip, one host with all its chips) need no
+    initialization at all — JAX sees local devices directly, matching the
+    reference's un-launched single-process path
+    (``distributed/init_utils.py:130-142``).
+    """
+    global _INITIALIZED
+    # jax.distributed.initialize autodetects coordinator/process_id/num_processes
+    # on TPU pods, SLURM, and GKE when args are None — pass through whatever the
+    # caller gave and let JAX fill the rest.  Skip entirely for explicit
+    # single-process runs (tests, one host with no cluster env), matching the
+    # reference's un-launched single-process path (init_utils.py:130-142).
+    cluster_env = any(
+        os.environ.get(v)
+        for v in (
+            "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+            "TPU_WORKER_HOSTNAMES", "SLURM_JOB_ID", "MEGASCALE_COORDINATOR_ADDRESS",
+        )
+    )
+    explicit = coordinator_address is not None or num_processes is not None
+    single_host = os.environ.get("TPU_WORKER_HOSTNAMES", "") in ("", "localhost")
+    if not _INITIALIZED and (explicit or (cluster_env and not single_host)):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _INITIALIZED = True
+        atexit.register(_shutdown)
+
+    rank = jax.process_index()
+    nproc = jax.process_count()
+    info = DistInfo(
+        backend=backend,
+        rank=rank,
+        world_size=jax.device_count(),
+        local_rank=0,
+        num_processes=nproc,
+        is_main=rank == 0,
+    )
+    logger.info(
+        "distributed: process %d/%d, %d devices (%d local)",
+        rank, nproc, jax.device_count(), jax.local_device_count(),
+    )
+    return info
+
+
+def _shutdown() -> None:
+    global _INITIALIZED
+    if _INITIALIZED:
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # pragma: no cover - teardown best effort
+            pass
+        _INITIALIZED = False
+
+
+def is_main_process() -> bool:
+    return jax.process_index() == 0

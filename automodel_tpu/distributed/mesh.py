@@ -1,0 +1,178 @@
+"""Device mesh construction: the TPU-native replacement for DeviceMesh/FSDP2.
+
+Where the reference builds a 4-D ``torch.distributed`` DeviceMesh and flattens
+submeshes (``nemo_automodel/components/distributed/fsdp2.py:117-221``), the TPU
+design is a single ``jax.sharding.Mesh`` with axes
+``('dp_replicate', 'dp_shard', 'cp', 'tp')``.  "Flattened" submeshes are not
+separate objects in JAX — a PartitionSpec may name a *tuple* of axes, so the
+reference's ``dp``/``dp_shard_cp``/``dp_cp`` flattened views become the axis
+tuples returned by :data:`DP_AXES`, :data:`FSDP_AXES`, :data:`LOSS_AXES`.
+
+HSDP guidance (scaling-book): the replicate axis is outermost so it lands on
+DCN between slices; shard/cp/tp axes ride ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names, outermost (DCN) to innermost (ICI).
+AXIS_DP_REPLICATE = "dp_replicate"
+AXIS_DP_SHARD = "dp_shard"
+AXIS_CP = "cp"
+AXIS_TP = "tp"
+MESH_AXES: Tuple[str, ...] = (AXIS_DP_REPLICATE, AXIS_DP_SHARD, AXIS_CP, AXIS_TP)
+
+# Flattened views (reference fsdp2.py:181-221):
+#   dp          = dp_replicate x dp_shard      -> data/batch sharding
+#   dp_shard_cp = dp_shard x cp                -> parameter (FSDP) sharding
+#   dp_cp       = dp_replicate x dp_shard x cp -> loss / token-count reduction
+DP_AXES: Tuple[str, ...] = (AXIS_DP_REPLICATE, AXIS_DP_SHARD)
+FSDP_AXES: Tuple[str, ...] = (AXIS_DP_SHARD, AXIS_CP)
+LOSS_AXES: Tuple[str, ...] = (AXIS_DP_REPLICATE, AXIS_DP_SHARD, AXIS_CP)
+BATCH_AXES: Tuple[str, ...] = (AXIS_DP_REPLICATE, AXIS_DP_SHARD)
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Sizing knobs, matching the reference ``FSDP2Manager`` constructor surface
+    (``distributed/fsdp2.py:36-116``): any size may be None to be inferred."""
+
+    dp_size: Optional[int] = None
+    dp_replicate_size: int = 1
+    tp_size: int = 1
+    cp_size: int = 1
+    sequence_parallel: bool = False
+
+
+class MeshManager:
+    """Builds and owns the global :class:`jax.sharding.Mesh`.
+
+    YAML-instantiable (``distributed._target_``), mirroring ``FSDP2Manager``:
+
+        distributed:
+          _target_: automodel_tpu.distributed.mesh.MeshManager
+          dp_size: none
+          dp_replicate_size: 1
+          tp_size: 1
+          cp_size: 1
+    """
+
+    def __init__(
+        self,
+        dp_size: Optional[int] = None,
+        dp_replicate_size: int = 1,
+        tp_size: int = 1,
+        cp_size: int = 1,
+        sequence_parallel: bool = False,
+        devices: Optional[Sequence[jax.Device]] = None,
+        allow_split_physical_axes: bool = True,
+        **_unused,
+    ):
+        self.sequence_parallel = bool(sequence_parallel)
+        devices = list(devices if devices is not None else jax.devices())
+        world = len(devices)
+
+        tp_size = _none_to(tp_size, 1)
+        cp_size = _none_to(cp_size, 1)
+        dp_replicate_size = _none_to(dp_replicate_size, 1)
+        dp_size = _none_to(dp_size, None)
+        if dp_size is None:
+            denom = tp_size * cp_size
+            if world % denom:
+                raise ValueError(
+                    f"world size {world} not divisible by tp*cp={denom}"
+                )
+            dp_size = world // denom
+        if dp_size % dp_replicate_size:
+            raise ValueError(
+                f"dp_size {dp_size} not divisible by dp_replicate_size {dp_replicate_size}"
+            )
+        dp_shard = dp_size // dp_replicate_size
+        total = dp_replicate_size * dp_shard * cp_size * tp_size
+        if total != world:
+            raise ValueError(
+                f"mesh {dp_replicate_size}x{dp_shard}x{cp_size}x{tp_size}={total} "
+                f"!= device count {world}"
+            )
+
+        self.shape: Tuple[int, int, int, int] = (
+            dp_replicate_size,
+            dp_shard,
+            cp_size,
+            tp_size,
+        )
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(
+                self.shape,
+                devices=devices,
+                allow_split_physical_axes=allow_split_physical_axes,
+            )
+        except Exception:
+            dev_array = np.asarray(devices).reshape(self.shape)
+        self.mesh = Mesh(dev_array, MESH_AXES)
+
+    # -- reference-parity size accessors ----------------------------------
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(self.shape))
+
+    @property
+    def dp_replicate_size(self) -> int:
+        return self.shape[0]
+
+    @property
+    def dp_shard_size(self) -> int:
+        return self.shape[1]
+
+    @property
+    def cp_size(self) -> int:
+        return self.shape[2]
+
+    @property
+    def tp_size(self) -> int:
+        return self.shape[3]
+
+    @property
+    def dp_size(self) -> int:
+        return self.shape[0] * self.shape[1]
+
+    @property
+    def loss_reduce_size(self) -> int:
+        """Size of the dp_cp group used for global token-count normalization."""
+        return self.dp_size * self.cp_size
+
+    def __enter__(self):
+        self._ctx = self.mesh
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+    def __repr__(self) -> str:
+        return f"MeshManager(shape={dict(zip(MESH_AXES, self.shape))})"
+
+
+def _none_to(v, default):
+    if v is None or (isinstance(v, str) and v.lower() in ("none", "null", "")):
+        return default
+    return int(v)
+
+
+def build_mesh(cfg=None, **kwargs) -> MeshManager:
+    """Convenience builder from a ConfigNode or kwargs."""
+    if cfg is not None:
+        fields = {k: cfg.get(k) for k in (
+            "dp_size", "dp_replicate_size", "tp_size", "cp_size", "sequence_parallel"
+        ) if k in cfg}
+        fields.update(kwargs)
+        kwargs = fields
+    return MeshManager(**kwargs)

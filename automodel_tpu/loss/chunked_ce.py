@@ -1,0 +1,51 @@
+"""Chunked cross-entropy: caps the fp32 logit-upcast working set.
+
+Reference parity (``nemo_automodel/components/loss/chunked_ce.py:22-106``):
+the sequence axis is processed in chunks so only one chunk of logits is ever
+upcast to fp32 at a time.  In JAX the chunk loop is a ``lax.map``, which XLA
+compiles to one kernel re-used per chunk.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.loss.masked_ce import IGNORE_INDEX, cross_entropy_sum
+
+
+class ChunkedCrossEntropy:
+    needs_hidden = False
+
+    def __init__(self, chunk_len: int = 32, ignore_index: int = IGNORE_INDEX):
+        assert ignore_index == IGNORE_INDEX
+        self.chunk_len = chunk_len
+
+    def __call__(
+        self,
+        logits: jnp.ndarray,   # [B, S, V]
+        labels: jnp.ndarray,   # [B, S]
+        mask: Optional[jnp.ndarray] = None,
+        num_label_tokens: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        B, S, V = logits.shape
+        if mask is not None:
+            labels = jnp.where(mask.astype(bool), labels, IGNORE_INDEX)
+        n_chunks = max(1, -(-S // self.chunk_len))
+        pad = n_chunks * self.chunk_len - S
+        if pad:
+            logits = jnp.pad(logits, ((0, 0), (0, pad), (0, 0)))
+            labels = jnp.pad(labels, ((0, 0), (0, pad)),
+                             constant_values=IGNORE_INDEX)
+        logits_c = logits.reshape(B, n_chunks, self.chunk_len, V).swapaxes(0, 1)
+        labels_c = labels.reshape(B, n_chunks, self.chunk_len).swapaxes(0, 1)
+        per_chunk = jax.lax.map(
+            lambda args: cross_entropy_sum(args[0], args[1]),
+            (logits_c, labels_c),
+        )
+        total = jnp.sum(per_chunk)
+        if num_label_tokens is not None:
+            total = total / num_label_tokens
+        return total

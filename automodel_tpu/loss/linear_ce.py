@@ -1,0 +1,70 @@
+"""Fused linear + cross-entropy: CE from hidden states without materializing
+the full [B, S, V] logit tensor.
+
+TPU re-design of the reference's ``FusedLinearCrossEntropy`` wrapping Apple
+cut-cross-entropy (``nemo_automodel/components/loss/linear_ce.py:118-170``):
+the model returns ``hidden_states`` + the lm_head kernel (reference
+``logits_to_keep=1`` path, ``recipes/llm/train_ft.py:436-460``), and the loss
+scans over sequence chunks — each chunk's [B, C, V] logits exist only inside
+one scan iteration and are rematerialized in the backward pass
+(``jax.checkpoint``), so peak memory is O(B*C*V) instead of O(B*S*V).
+XLA fuses the chunk matmul + logsumexp; a Pallas kernel can tighten this
+further later.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.loss.masked_ce import IGNORE_INDEX
+
+
+class FusedLinearCrossEntropy:
+    needs_hidden = True
+
+    def __init__(self, chunk_len: int = 512, ignore_index: int = IGNORE_INDEX):
+        assert ignore_index == IGNORE_INDEX
+        self.chunk_len = chunk_len
+
+    def __call__(
+        self,
+        hidden_states: jnp.ndarray,    # [B, S, H]
+        lm_head_kernel: jnp.ndarray,   # [H, V]
+        labels: jnp.ndarray,           # [B, S]
+        mask: Optional[jnp.ndarray] = None,
+        num_label_tokens: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        B, S, H = hidden_states.shape
+        if mask is not None:
+            labels = jnp.where(mask.astype(bool), labels, IGNORE_INDEX)
+        C = min(self.chunk_len, S)
+        n_chunks = -(-S // C)
+        pad = n_chunks * C - S
+        if pad:
+            hidden_states = jnp.pad(hidden_states, ((0, 0), (0, pad), (0, 0)))
+            labels = jnp.pad(labels, ((0, 0), (0, pad)),
+                             constant_values=IGNORE_INDEX)
+        hs = hidden_states.reshape(B, n_chunks, C, H).swapaxes(0, 1)
+        lb = labels.reshape(B, n_chunks, C).swapaxes(0, 1)
+        kernel = lm_head_kernel.astype(hidden_states.dtype)
+
+        @jax.checkpoint
+        def chunk_loss(h, l):
+            logits = (h @ kernel).astype(jnp.float32)   # [B, C, V] — transient
+            valid = l != IGNORE_INDEX
+            safe = jnp.where(valid, l, 0)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            picked = jnp.take_along_axis(logits, safe[..., None], -1).squeeze(-1)
+            return jnp.sum(jnp.where(valid, lse - picked, 0.0))
+
+        def body(acc, args):
+            h, l = args
+            return acc + chunk_loss(h, l), None
+
+        total, _ = jax.lax.scan(body, jnp.float32(0.0), (hs, lb))
+        if num_label_tokens is not None:
+            total = total / num_label_tokens
+        return total

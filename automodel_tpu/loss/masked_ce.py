@@ -1,0 +1,61 @@
+"""Masked cross-entropy — the framework's default SFT loss.
+
+Reference parity (``nemo_automodel/components/loss/masked_ce.py:20-76``):
+fp32-upcast CE, optional mask folded into the ``ignore_index`` convention,
+**sum** reduction divided by the *global* label-token count — per-token loss
+normalization across the dp_cp group is the framework-wide convention (the
+caller supplies ``num_label_tokens`` already summed over dp_cp via psum).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100
+
+
+def cross_entropy_sum(
+    logits: jnp.ndarray,   # [..., V]
+    labels: jnp.ndarray,   # [...] int, IGNORE_INDEX masked out
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Sum of token CE in fp32. Ignored positions contribute exactly 0."""
+    if mask is not None:
+        labels = jnp.where(mask.astype(bool), labels, IGNORE_INDEX)
+    valid = labels != IGNORE_INDEX
+    safe_labels = jnp.where(valid, labels, 0)
+    logits32 = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits32, axis=-1)
+    picked = jnp.take_along_axis(
+        logits32, safe_labels[..., None], axis=-1
+    ).squeeze(-1)
+    tok_loss = jnp.where(valid, lse - picked, 0.0)
+    return jnp.sum(tok_loss)
+
+
+class MaskedCrossEntropy:
+    """``loss_fn._target_: automodel_tpu.loss.masked_ce.MaskedCrossEntropy``"""
+
+    needs_hidden = False
+
+    def __init__(self, ignore_index: int = IGNORE_INDEX, reduction: str = "sum"):
+        assert ignore_index == IGNORE_INDEX, "only -100 supported"
+        self.reduction = reduction
+
+    def __call__(
+        self,
+        logits: jnp.ndarray,
+        labels: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+        num_label_tokens: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        total = cross_entropy_sum(logits, labels, mask)
+        if self.reduction == "mean" and num_label_tokens is None:
+            num_label_tokens = jnp.maximum(
+                jnp.sum(labels != IGNORE_INDEX), 1)
+        if num_label_tokens is not None:
+            total = total / num_label_tokens
+        return total

@@ -1,0 +1,89 @@
+"""AutoModel facade: HF-checkpoint-driven model construction.
+
+Equivalent of the reference's ``NeMoAutoModelForCausalLM``
+(``nemo_automodel/components/_transformers/auto_model.py:169-445``), minus the
+attention-implementation fallback chain — on TPU the attention backend is a
+framework choice (XLA SDPA or Pallas flash), not a per-model patch.
+
+``from_pretrained`` resolves a local path or an HF-cache snapshot, parses
+``config.json``, and builds the matching functional model.  Weight loading is
+deliberately a separate step (``load_hf_weights``) so recipes can compute
+shardings first and stream weights straight into device shards — the
+meta-device-init flow (``checkpoint/checkpointing.py:176-237``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+from automodel_tpu.models.hf_io import load_hf_weights
+from automodel_tpu.models.registry import get_family
+
+
+def resolve_checkpoint_dir(name_or_path: str) -> Optional[str]:
+    """Resolve a model id to a local directory: direct path, or HF cache snapshot
+    (reference ``get_safetensors_index_path``, ``checkpoint/checkpointing.py:495``)."""
+    if os.path.isdir(name_or_path):
+        return name_or_path
+    hf_home = os.environ.get("HF_HOME", os.path.expanduser("~/.cache/huggingface"))
+    repo_dir = os.path.join(
+        hf_home, "hub", "models--" + name_or_path.replace("/", "--"))
+    snap_root = os.path.join(repo_dir, "snapshots")
+    if os.path.isdir(snap_root):
+        ref_main = os.path.join(repo_dir, "refs", "main")
+        if os.path.exists(ref_main):
+            with open(ref_main) as f:
+                rev = f.read().strip()
+            cand = os.path.join(snap_root, rev)
+            if os.path.isdir(cand):
+                return cand
+        snaps = sorted(os.listdir(snap_root))
+        if snaps:
+            return os.path.join(snap_root, snaps[-1])
+    return None
+
+
+class AutoModelForCausalLM:
+    """``_target_: automodel_tpu.models.auto_model.AutoModelForCausalLM.from_pretrained``"""
+
+    @staticmethod
+    def from_config(config: Any, **model_kwargs) -> Any:
+        """Build from an HF-style config dict (or a ready config dataclass)."""
+        if isinstance(config, dict):
+            family = get_family(config.get("model_type", "llama"))
+            config = family.config_cls.from_hf_config(config)
+        return get_family(config.model_type).model_cls(config, **model_kwargs)
+
+    @staticmethod
+    def from_pretrained(
+        pretrained_model_name_or_path: str,
+        load_weights: bool = False,
+        **model_kwargs,
+    ) -> Any:
+        ckpt_dir = resolve_checkpoint_dir(pretrained_model_name_or_path)
+        if ckpt_dir is None:
+            raise FileNotFoundError(
+                f"Cannot resolve {pretrained_model_name_or_path!r} to a local "
+                "checkpoint directory (no network egress; pre-populate the HF "
+                "cache or pass a local path)")
+        with open(os.path.join(ckpt_dir, "config.json")) as f:
+            hf_cfg = json.load(f)
+        model = AutoModelForCausalLM.from_config(hf_cfg, **model_kwargs)
+        model.checkpoint_dir = ckpt_dir
+        if load_weights:
+            model.params = load_hf_weights(model, ckpt_dir)
+        return model
+
+
+def build_model(name_or_path: Optional[str] = None, config: Optional[dict] = None,
+                **kwargs) -> Any:
+    """YAML-friendly builder: from checkpoint path or inline config dict."""
+    if name_or_path is not None:
+        return AutoModelForCausalLM.from_pretrained(name_or_path, **kwargs)
+    if config is not None:
+        if hasattr(config, "to_dict"):
+            config = config.to_dict()
+        return AutoModelForCausalLM.from_config(config, **kwargs)
+    raise ValueError("build_model needs name_or_path or config")

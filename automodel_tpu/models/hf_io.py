@@ -1,0 +1,332 @@
+"""HF safetensors <-> pytree weight round-trip.
+
+TPU re-design of the reference's parallel HF weight load
+(``nemo_automodel/components/checkpoint/checkpointing.py:176-237``) and the
+DCP safetensors storage layer (``checkpoint/_backports/hf_storage.py:67-393``):
+
+* **Load**: each param is materialized with ``jax.make_array_from_callback``
+  against lazily-opened safetensors files — every host/device reads only the
+  byte ranges of its own shards, so 70B checkpoints stream straight into
+  sharded device arrays with no host-RAM blowup (the meta-device-init
+  equivalent).
+* **Save**: the inverse mapping writes standard HF ``model-xxxxx-of-xxxxx
+  .safetensors`` shards plus ``model.safetensors.index.json`` — a consolidated
+  HF repo a reference user can load back with ``AutoModelForCausalLM``.
+
+Key maps translate between HF names (``model.layers.{i}.self_attn.q_proj
+.weight``, torch ``(out, in)`` layout) and our stacked pytree
+(``layers/self_attn/q_proj/kernel``, ``(L, in, out)``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SAFETENSORS_INDEX = "model.safetensors.index.json"
+
+
+# ---------------------------------------------------------------------------
+# Key maps.  Entry: tree path (tuple of str) -> HfSpec
+# ---------------------------------------------------------------------------
+class HfSpec:
+    """How one pytree param maps onto HF tensors.
+
+    ``template`` contains ``{i}`` when the param is a stack over layers.
+    ``transpose``: HF stores torch Linear as (out, in); our kernel is (in, out).
+    """
+
+    def __init__(self, template: str, stacked: bool = False, transpose: bool = False):
+        self.template = template
+        self.stacked = stacked
+        self.transpose = transpose
+
+
+def llama_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    m: Dict[Tuple[str, ...], HfSpec] = {
+        ("embed_tokens", "embedding"): HfSpec("model.embed_tokens.weight"),
+        ("norm", "weight"): HfSpec("model.norm.weight"),
+        ("layers", "input_layernorm", "weight"): HfSpec(
+            "model.layers.{i}.input_layernorm.weight", stacked=True),
+        ("layers", "post_attention_layernorm", "weight"): HfSpec(
+            "model.layers.{i}.post_attention_layernorm.weight", stacked=True),
+    }
+    for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+        m[("layers", "self_attn", proj, "kernel")] = HfSpec(
+            f"model.layers.{{i}}.self_attn.{proj}.weight", stacked=True, transpose=True)
+    if config.attention_bias:
+        for proj in ("q_proj", "k_proj", "v_proj"):
+            m[("layers", "self_attn", proj, "bias")] = HfSpec(
+                f"model.layers.{{i}}.self_attn.{proj}.bias", stacked=True)
+    if config.qk_norm:
+        m[("layers", "self_attn", "q_norm", "weight")] = HfSpec(
+            "model.layers.{i}.self_attn.q_norm.weight", stacked=True)
+        m[("layers", "self_attn", "k_norm", "weight")] = HfSpec(
+            "model.layers.{i}.self_attn.k_norm.weight", stacked=True)
+    for proj in ("gate_proj", "up_proj", "down_proj"):
+        m[("layers", "mlp", proj, "kernel")] = HfSpec(
+            f"model.layers.{{i}}.mlp.{proj}.weight", stacked=True, transpose=True)
+    if not config.tie_word_embeddings:
+        m[("lm_head", "kernel")] = HfSpec("lm_head.weight", transpose=True)
+    return m
+
+
+def gpt2_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    # HF GPT-2 uses Conv1D: weights already (in, out) — no transpose.
+    m: Dict[Tuple[str, ...], HfSpec] = {
+        ("wte", "embedding"): HfSpec("wte.weight"),
+        ("wpe", "embedding"): HfSpec("wpe.weight"),
+        ("ln_f", "weight"): HfSpec("ln_f.weight"),
+        ("ln_f", "bias"): HfSpec("ln_f.bias"),
+    }
+    if not config.tie_word_embeddings:
+        m[("lm_head", "kernel")] = HfSpec("lm_head.weight", transpose=True)
+    for ln in ("ln_1", "ln_2"):
+        for wb in ("weight", "bias"):
+            m[("h", ln, wb)] = HfSpec(f"h.{{i}}.{ln}.{wb}", stacked=True)
+    for mod, sub in (("attn", "c_attn"), ("attn", "c_proj"),
+                     ("mlp", "c_fc"), ("mlp", "c_proj")):
+        m[("h", mod, sub, "kernel")] = HfSpec(f"h.{{i}}.{mod}.{sub}.weight", stacked=True)
+        m[("h", mod, sub, "bias")] = HfSpec(f"h.{{i}}.{mod}.{sub}.bias", stacked=True)
+    return m
+
+
+def _key_map_for(model) -> Dict[Tuple[str, ...], HfSpec]:
+    from automodel_tpu.models.registry import get_family
+
+    return get_family(model.config.model_type).key_map_fn(model.config)
+
+
+# ---------------------------------------------------------------------------
+# Reading
+# ---------------------------------------------------------------------------
+class _LazyCheckpoint:
+    """Lazily-opened safetensors shard set with per-slice reads."""
+
+    def __init__(self, ckpt_dir: str):
+        from safetensors import safe_open
+
+        self._safe_open = safe_open
+        self.ckpt_dir = ckpt_dir
+        index_path = os.path.join(ckpt_dir, SAFETENSORS_INDEX)
+        if os.path.exists(index_path):
+            with open(index_path) as f:
+                self.weight_map: Dict[str, str] = json.load(f)["weight_map"]
+        else:
+            single = os.path.join(ckpt_dir, "model.safetensors")
+            if not os.path.exists(single):
+                raise FileNotFoundError(
+                    f"No model.safetensors[.index.json] under {ckpt_dir}")
+            with safe_open(single, framework="numpy") as f:
+                self.weight_map = {k: "model.safetensors" for k in f.keys()}
+        self._handles: Dict[str, Any] = {}
+
+    def _file(self, fname: str):
+        if fname not in self._handles:
+            self._handles[fname] = self._safe_open(
+                os.path.join(self.ckpt_dir, fname), framework="numpy")
+        return self._handles[fname]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.weight_map
+
+    def get_slice(self, key: str, idx: Tuple[slice, ...]) -> np.ndarray:
+        sl = self._file(self.weight_map[key]).get_slice(key)
+        return sl[idx]
+
+    def get(self, key: str) -> np.ndarray:
+        return self._file(self.weight_map[key]).get_tensor(key)
+
+
+def _hf_slice(spec: HfSpec, layer: Optional[int], idx: Tuple[slice, ...],
+              ckpt: _LazyCheckpoint, dtype) -> np.ndarray:
+    key = spec.template.format(i=layer) if spec.stacked else spec.template
+    if spec.transpose:
+        # requested (in, out) slice -> read (out, in) then transpose
+        hf_idx = (idx[1], idx[0]) if len(idx) == 2 else idx[::-1]
+        arr = ckpt.get_slice(key, hf_idx).T
+    else:
+        arr = ckpt.get_slice(key, idx)
+    return arr.astype(dtype)
+
+
+def load_hf_weights(
+    model,
+    ckpt_dir: str,
+    shardings: Optional[Any] = None,
+    abstract: Optional[Any] = None,
+) -> Dict[str, Any]:
+    """Stream an HF checkpoint directory into a (sharded) param pytree.
+
+    ``shardings``: pytree of ``jax.sharding.Sharding`` matching the param tree
+    (None -> fully replicated / single device).  Each addressable shard pulls
+    only its own byte ranges via safetensors slicing.
+    """
+    ckpt = _LazyCheckpoint(ckpt_dir)
+    key_map = _key_map_for(model)
+    abstract = abstract if abstract is not None else model.abstract_params()
+    flat_abs = _flatten(abstract)
+    flat_shard = _flatten(shardings) if shardings is not None else {
+        p: None for p in flat_abs}
+
+    out_flat: Dict[Tuple[str, ...], jax.Array] = {}
+    for path, aval in flat_abs.items():
+        spec = key_map.get(path)
+        if spec is None:
+            raise KeyError(f"No HF mapping for param {'/'.join(path)}")
+        shape, dtype = aval.shape, aval.dtype
+        sharding = flat_shard.get(path)
+        if sharding is None:
+            sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+
+        def cb(idx: Tuple[slice, ...], spec=spec, shape=shape, dtype=dtype):
+            if spec.stacked:
+                lsl = idx[0]
+                start, stop, _ = lsl.indices(shape[0])
+                parts = [
+                    _hf_slice(spec, i, idx[1:], ckpt, dtype)
+                    for i in range(start, stop)
+                ]
+                return np.stack(parts, axis=0)
+            return _hf_slice(spec, None, idx, ckpt, dtype)
+
+        out_flat[path] = jax.make_array_from_callback(shape, sharding, cb)
+    return _unflatten(out_flat)
+
+
+# ---------------------------------------------------------------------------
+# Writing (consolidated HF repo)
+# ---------------------------------------------------------------------------
+def save_hf_weights(
+    model,
+    params: Dict[str, Any],
+    out_dir: str,
+    max_shard_bytes: int = 5 * 1024**3,
+    save_dtype: Optional[Any] = None,
+) -> None:
+    """Write params as a consolidated HF safetensors repo (+ index + config.json).
+
+    Only process 0 writes (params are fully addressable after an
+    all-gather-on-read of each leaf).  Mirrors the reference's consolidation
+    output (``checkpoint/_backports/consolidate_hf_safetensors.py:794``).
+    """
+    from safetensors.numpy import save_file
+
+    key_map = _key_map_for(model)
+    flat = _flatten(params)
+    save_dtype = np.dtype(save_dtype) if save_dtype is not None else None
+    is_writer = jax.process_index() == 0
+
+    def materialize(v) -> np.ndarray:
+        # Cross-host-sharded leaves need a collective gather that EVERY
+        # process participates in; fully-addressable ones are a local copy.
+        if isinstance(v, jax.Array) and not v.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            arr = np.asarray(multihost_utils.process_allgather(v, tiled=True))
+        else:
+            arr = np.asarray(jax.device_get(v))
+        return arr.astype(save_dtype) if save_dtype is not None else arr
+
+    # Expand stacked params to per-layer HF tensors, lazily.
+    entries: List[Tuple[str, Callable[[], np.ndarray]]] = []
+    for path, value in flat.items():
+        spec = key_map.get(path)
+        if spec is None:
+            raise KeyError(f"No HF mapping for param {'/'.join(path)}")
+
+        if spec.stacked:
+            n_layers = value.shape[0]
+            for i in range(n_layers):
+                def layer_fn(v=value, i=i, spec=spec):
+                    arr = materialize(v[i])
+                    return arr.T if spec.transpose else arr
+                entries.append((spec.template.format(i=i), layer_fn))
+        else:
+            def full_fn(v=value, spec=spec):
+                arr = materialize(v)
+                return arr.T if spec.transpose else arr
+            entries.append((spec.template, full_fn))
+
+    if is_writer:
+        os.makedirs(out_dir, exist_ok=True)
+
+    # Greedy sharding by byte budget, materializing one tensor at a time.
+    # All processes run the loop (the gathers are collective); only process 0
+    # keeps the arrays and writes files.
+    final_shards: List[Dict[str, np.ndarray]] = []
+    cur: Dict[str, np.ndarray] = {}
+    cur_bytes = 0
+    for name, fn in entries:
+        arr = fn()
+        if not is_writer:
+            continue
+        if cur and cur_bytes + arr.nbytes > max_shard_bytes:
+            final_shards.append(cur)
+            cur, cur_bytes = {}, 0
+        cur[name] = arr
+        cur_bytes += arr.nbytes
+    if cur:
+        final_shards.append(cur)
+    if not is_writer:
+        return
+
+    n = len(final_shards)
+    weight_map: Dict[str, str] = {}
+    total = 0
+    for i, shard in enumerate(final_shards):
+        fname = (
+            "model.safetensors" if n == 1
+            else f"model-{i + 1:05d}-of-{n:05d}.safetensors"
+        )
+        save_file(shard, os.path.join(out_dir, fname),
+                  metadata={"format": "pt"})
+        for k, v in shard.items():
+            weight_map[k] = fname
+            total += v.nbytes
+    with open(os.path.join(out_dir, SAFETENSORS_INDEX), "w") as f:
+        json.dump(
+            {"metadata": {"total_size": total}, "weight_map": weight_map},
+            f, indent=2)
+    save_hf_config(model, out_dir)
+
+
+def save_hf_config(model, out_dir: str) -> None:
+    import dataclasses
+
+    from automodel_tpu.models.registry import get_family
+
+    cfg = model.config
+    d = dataclasses.asdict(cfg)
+    d["architectures"] = get_family(cfg.model_type).hf_architectures
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(d, f, indent=2, default=str)
+
+
+# ---------------------------------------------------------------------------
+# pytree flatten helpers (path-keyed dicts)
+# ---------------------------------------------------------------------------
+def _flatten(tree: Any, prefix: Tuple[str, ...] = ()) -> Dict[Tuple[str, ...], Any]:
+    out: Dict[Tuple[str, ...], Any] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, prefix + (str(k),)))
+    else:
+        out[prefix] = tree
+    return out
+
+
+def _unflatten(flat: Dict[Tuple[str, ...], Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for path, v in flat.items():
+        node = out
+        for part in path[:-1]:
+            node = node.setdefault(part, {})
+        node[path[-1]] = v
+    return out

@@ -1,0 +1,239 @@
+"""Llama-family decoder (Llama 2/3/3.x, Mistral, Qwen2, Qwen3) — pure-JAX pytree model.
+
+TPU-first re-design of what the reference gets from HF transformers via
+``NeMoAutoModelForCausalLM`` (``nemo_automodel/components/_transformers/
+auto_model.py:169-414``): parameters are a nested-dict pytree; all decoder
+layers are *stacked* along a leading axis and the forward runs one
+``lax.scan`` over them — one compiled layer body regardless of depth (fast
+XLA compile at 70B scale), with ``jax.checkpoint`` rematerialization applied
+to the scan body to trade FLOPs for HBM.
+
+Weights live in param dtype (default fp32), compute runs in ``compute_dtype``
+(default bf16, the MXU-native type).  HF safetensors round-trip is defined by
+:func:`hf_key_map` in ``automodel_tpu/models/hf_io.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rotary import apply_rope, rope_frequencies
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    """Superset config covering Llama / Mistral / Qwen2 / Qwen3 (HF field names)."""
+
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 8192
+    num_hidden_layers: int = 16
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    head_dim: Optional[int] = None
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    rope_scaling: Optional[dict] = None
+    max_position_embeddings: int = 131072
+    tie_word_embeddings: bool = True
+    attention_bias: bool = False       # Qwen2: True
+    qk_norm: bool = False              # Qwen3: True (per-head RMSNorm on q/k)
+    attention_dropout: float = 0.0     # accepted, unused (SFT default 0)
+    model_type: str = "llama"
+    torch_dtype: str = "bfloat16"
+
+    def __post_init__(self):
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def from_hf_config(cls, hf: Dict[str, Any]) -> "LlamaConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in hf.items() if k in known}
+        if hf.get("model_type") == "qwen2":
+            kwargs.setdefault("attention_bias", True)
+        if hf.get("model_type") == "qwen3":
+            kwargs["qk_norm"] = True
+        return cls(**kwargs)
+
+
+class LlamaForCausalLM:
+    """Functional model: ``init`` builds the param pytree, ``__call__`` applies it."""
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        param_dtype: jnp.dtype = jnp.float32,
+        compute_dtype: jnp.dtype = jnp.bfloat16,
+        remat: bool = True,
+        remat_policy: Optional[str] = "nothing_saveable",
+    ):
+        self.config = config
+        self.param_dtype = jnp.dtype(param_dtype)
+        self.compute_dtype = jnp.dtype(compute_dtype)
+        self.remat = remat
+        self.remat_policy = remat_policy
+        self.inv_freq = rope_frequencies(
+            config.head_dim, config.rope_theta, config.rope_scaling
+        )
+
+    # -- init --------------------------------------------------------------
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        cfg = self.config
+        L, H, I = cfg.num_hidden_layers, cfg.hidden_size, cfg.intermediate_size
+        D, Hq, Hk = cfg.head_dim, cfg.num_attention_heads, cfg.num_key_value_heads
+        keys = iter(jax.random.split(key, 16))
+
+        def dense(k, shape, layers=True):
+            full = (L, *shape) if layers else shape
+            return (jax.random.normal(k, full, jnp.float32) * 0.02).astype(self.param_dtype)
+
+        ones = lambda shape: jnp.ones(shape, self.param_dtype)
+        attn = {
+            "q_proj": {"kernel": dense(next(keys), (H, Hq * D))},
+            "k_proj": {"kernel": dense(next(keys), (H, Hk * D))},
+            "v_proj": {"kernel": dense(next(keys), (H, Hk * D))},
+            "o_proj": {"kernel": dense(next(keys), (Hq * D, H))},
+        }
+        if cfg.attention_bias:
+            attn["q_proj"]["bias"] = jnp.zeros((L, Hq * D), self.param_dtype)
+            attn["k_proj"]["bias"] = jnp.zeros((L, Hk * D), self.param_dtype)
+            attn["v_proj"]["bias"] = jnp.zeros((L, Hk * D), self.param_dtype)
+        if cfg.qk_norm:
+            attn["q_norm"] = {"weight": ones((L, D))}
+            attn["k_norm"] = {"weight": ones((L, D))}
+        params: Dict[str, Any] = {
+            "embed_tokens": {
+                "embedding": (
+                    jax.random.normal(next(keys), (cfg.vocab_size, H), jnp.float32) * 0.02
+                ).astype(self.param_dtype)
+            },
+            "layers": {
+                "input_layernorm": {"weight": ones((L, H))},
+                "self_attn": attn,
+                "post_attention_layernorm": {"weight": ones((L, H))},
+                "mlp": {
+                    "gate_proj": {"kernel": dense(next(keys), (H, I))},
+                    "up_proj": {"kernel": dense(next(keys), (H, I))},
+                    "down_proj": {"kernel": dense(next(keys), (I, H))},
+                },
+            },
+            "norm": {"weight": ones((H,))},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"kernel": dense(next(keys), (H, cfg.vocab_size), layers=False)}
+        return params
+
+    def abstract_params(self) -> Dict[str, Any]:
+        return jax.eval_shape(self.init, jax.random.key(0))
+
+    # -- forward -----------------------------------------------------------
+    def _decoder_layer(self, hidden, layer_params, position_ids, segment_ids,
+                       attention_mask, inv_freq):
+        cfg = self.config
+        B, S, H = hidden.shape
+        D, Hq, Hk = cfg.head_dim, cfg.num_attention_heads, cfg.num_key_value_heads
+        p = layer_params
+        cd = self.compute_dtype
+
+        def proj(x, w, name):
+            y = x @ w["kernel"].astype(cd)
+            if "bias" in w:
+                y = y + w["bias"].astype(cd)
+            return y
+
+        # Attention block
+        resid = hidden
+        x = rms_norm(hidden, p["input_layernorm"]["weight"], cfg.rms_norm_eps)
+        q = proj(x, p["self_attn"]["q_proj"], "q").reshape(B, S, Hq, D)
+        k = proj(x, p["self_attn"]["k_proj"], "k").reshape(B, S, Hk, D)
+        v = proj(x, p["self_attn"]["v_proj"], "v").reshape(B, S, Hk, D)
+        if cfg.qk_norm:
+            q = rms_norm(q, p["self_attn"]["q_norm"]["weight"], cfg.rms_norm_eps)
+            k = rms_norm(k, p["self_attn"]["k_norm"]["weight"], cfg.rms_norm_eps)
+        q, k = apply_rope(q, k, position_ids, inv_freq)
+        attn = dot_product_attention(
+            q, k, v,
+            causal=True,
+            segment_ids=segment_ids,
+            attention_mask=attention_mask,
+        )
+        attn = attn.reshape(B, S, Hq * D) @ p["self_attn"]["o_proj"]["kernel"].astype(cd)
+        hidden = resid + attn
+
+        # MLP block (SwiGLU)
+        resid = hidden
+        x = rms_norm(hidden, p["post_attention_layernorm"]["weight"], cfg.rms_norm_eps)
+        gate = x @ p["mlp"]["gate_proj"]["kernel"].astype(cd)
+        up = x @ p["mlp"]["up_proj"]["kernel"].astype(cd)
+        down = (jax.nn.silu(gate) * up) @ p["mlp"]["down_proj"]["kernel"].astype(cd)
+        return resid + down
+
+    def __call__(
+        self,
+        params: Dict[str, Any],
+        input_ids: jnp.ndarray,                 # [B, S] int32
+        position_ids: Optional[jnp.ndarray] = None,
+        segment_ids: Optional[jnp.ndarray] = None,
+        attention_mask: Optional[jnp.ndarray] = None,
+        return_hidden: bool = False,
+    ) -> Dict[str, jnp.ndarray]:
+        """Forward pass. Returns ``{"logits": ...}`` or, with ``return_hidden``,
+        ``{"hidden_states": ..., "lm_head_kernel": ...}`` for fused linear CE
+        (the reference's logits_to_keep path, ``recipes/llm/train_ft.py:436-460``)."""
+        cfg = self.config
+        B, S = input_ids.shape
+        if position_ids is None:
+            position_ids = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+        hidden = params["embed_tokens"]["embedding"][input_ids].astype(self.compute_dtype)
+        inv_freq = jnp.asarray(self.inv_freq)
+
+        def body(h, layer_params):
+            return self._decoder_layer(
+                h, layer_params, position_ids, segment_ids, attention_mask, inv_freq
+            ), None
+
+        if self.remat:
+            policy = None
+            if self.remat_policy and self.remat_policy != "none":
+                policy = getattr(jax.checkpoint_policies, self.remat_policy, None)
+            body = jax.checkpoint(body, policy=policy, prevent_cse=False)
+        hidden, _ = lax.scan(body, hidden, params["layers"])
+
+        hidden = rms_norm(hidden, params["norm"]["weight"], cfg.rms_norm_eps)
+        lm_kernel = (
+            params["embed_tokens"]["embedding"].T
+            if cfg.tie_word_embeddings
+            else params["lm_head"]["kernel"]
+        )
+        if return_hidden:
+            return {"hidden_states": hidden, "lm_head_kernel": lm_kernel}
+        logits = hidden @ lm_kernel.astype(self.compute_dtype)
+        return {"logits": logits}
+
+    @property
+    def num_params(self) -> int:
+        return sum(
+            int(jnp.prod(jnp.array(x.shape)))
+            for x in jax.tree.leaves(self.abstract_params())
+        )
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs/token (fwd+bwd = 6N for matmul params)."""
+        cfg = self.config
+        per_layer = (
+            2 * cfg.hidden_size * (cfg.num_attention_heads + 2 * cfg.num_key_value_heads) * cfg.head_dim
+            + 2 * cfg.num_attention_heads * cfg.head_dim * cfg.hidden_size
+            + 6 * cfg.hidden_size * cfg.intermediate_size
+        )
+        embed = 2 * cfg.vocab_size * cfg.hidden_size
+        return 3.0 * (cfg.num_hidden_layers * per_layer + embed)

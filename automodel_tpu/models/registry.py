@@ -1,0 +1,68 @@
+"""Single registry of model families.
+
+One entry per ``model_type`` holds everything the framework needs to know
+about a family: config class, model class, HF key map builder, and the HF
+``architectures`` string for exported ``config.json``.  New families register
+here once (vs. the reference's per-model dicts scattered across
+``_transformers/auto_model.py`` and ``distributed/optimized_tp_plans.py:235``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelFamily:
+    model_type: str
+    config_cls: type
+    model_cls: type
+    key_map_fn: Callable          # config -> {tree path: HfSpec}
+    hf_architectures: List[str]
+
+
+_REGISTRY: Dict[str, ModelFamily] = {}
+
+
+def register_model(family: ModelFamily) -> None:
+    _REGISTRY[family.model_type] = family
+
+
+def get_family(model_type: str) -> ModelFamily:
+    _ensure_builtin()
+    if model_type not in _REGISTRY:
+        raise KeyError(
+            f"Unknown model_type {model_type!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[model_type]
+
+
+def known_model_types() -> List[str]:
+    _ensure_builtin()
+    return sorted(_REGISTRY)
+
+
+_BUILTIN_DONE = False
+
+
+def _ensure_builtin() -> None:
+    """Lazy registration avoids import cycles (model modules import nothing
+    from here; this module imports them only on first lookup)."""
+    global _BUILTIN_DONE
+    if _BUILTIN_DONE:
+        return
+    _BUILTIN_DONE = True
+    from automodel_tpu.models import hf_io
+    from automodel_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    for mt, arch in (
+        ("llama", "LlamaForCausalLM"),
+        ("mistral", "MistralForCausalLM"),
+        ("qwen2", "Qwen2ForCausalLM"),
+        ("qwen3", "Qwen3ForCausalLM"),
+    ):
+        register_model(ModelFamily(mt, LlamaConfig, LlamaForCausalLM,
+                                   hf_io.llama_key_map, [arch]))
+    register_model(ModelFamily("gpt2", GPT2Config, GPT2LMHeadModel,
+                               hf_io.gpt2_key_map, ["GPT2LMHeadModel"]))

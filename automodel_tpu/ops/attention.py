@@ -1,0 +1,96 @@
+"""Attention ops: XLA-fused SDPA with GQA, causal + segment-id + padding masks.
+
+This is the reference-semantics attention path (the reference's SDPA fallback,
+``_transformers/auto_model.py:50-88``).  Sequence packing uses *segment ids*
+instead of the reference's 4-D block-diagonal masks
+(``datasets/llm/packed_sequence.py:278-322``) — the TPU-idiomatic encoding that
+Pallas flash kernels consume directly.  A Pallas flash-attention kernel
+(`automodel_tpu.ops.pallas.flash_attention`) overrides this on TPU for long
+sequences; this XLA version is the portable fallback and the CPU test path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def make_attention_mask(
+    q_len: int,
+    kv_len: int,
+    *,
+    causal: bool = True,
+    segment_ids_q: Optional[jnp.ndarray] = None,  # [B, Sq] int, 0 = padding
+    segment_ids_kv: Optional[jnp.ndarray] = None,  # [B, Skv]
+    padding_mask_kv: Optional[jnp.ndarray] = None,  # [B, Skv] bool/int, 1 = keep
+    q_offset: int | jnp.ndarray = 0,
+) -> Optional[jnp.ndarray]:
+    """Boolean mask [B or 1, 1, Sq, Skv]; True = attend.
+
+    ``q_offset`` shifts query positions relative to keys — used by ring /
+    sharded attention where this host's queries start mid-sequence.
+    """
+    masks = []
+    if causal:
+        q_pos = jnp.arange(q_len) + q_offset
+        kv_pos = jnp.arange(kv_len)
+        masks.append((q_pos[:, None] >= kv_pos[None, :])[None, None])
+    if segment_ids_q is not None and segment_ids_kv is not None:
+        seg = segment_ids_q[:, None, :, None] == segment_ids_kv[:, None, None, :]
+        # segment id 0 marks padding: never attend to/from it
+        seg &= (segment_ids_kv != 0)[:, None, None, :]
+        masks.append(seg)
+    if padding_mask_kv is not None:
+        masks.append(padding_mask_kv.astype(bool)[:, None, None, :])
+    if not masks:
+        return None
+    out = masks[0]
+    for m in masks[1:]:
+        out = out & m
+    return out
+
+
+def dot_product_attention(
+    q: jnp.ndarray,  # [B, Sq, Hq, D]
+    k: jnp.ndarray,  # [B, Skv, Hk, D]
+    v: jnp.ndarray,  # [B, Skv, Hk, D]
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jnp.ndarray] = None,     # [B, S] packed-sequence ids
+    attention_mask: Optional[jnp.ndarray] = None,  # [B, Skv] padding mask
+    scale: Optional[float] = None,
+    logits_soft_cap: Optional[float] = None,
+    q_offset: int | jnp.ndarray = 0,
+) -> jnp.ndarray:
+    """Grouped-query SDPA. fp32 softmax, bf16-friendly matmuls (MXU path)."""
+    B, Sq, Hq, D = q.shape
+    _, Skv, Hk, _ = k.shape
+    assert Hq % Hk == 0, f"query heads {Hq} not a multiple of kv heads {Hk}"
+    G = Hq // Hk
+    scale = D ** -0.5 if scale is None else scale
+
+    qg = q.reshape(B, Sq, Hk, G, D)
+    # [B, Hk, G, Sq, Skv]
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k, precision=jax.lax.Precision.DEFAULT)
+    logits = logits.astype(jnp.float32) * scale
+    if logits_soft_cap is not None:
+        logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+
+    mask = make_attention_mask(
+        Sq, Skv,
+        causal=causal,
+        segment_ids_q=segment_ids,
+        segment_ids_kv=segment_ids,
+        padding_mask_kv=attention_mask,
+        q_offset=q_offset,
+    )
+    if mask is not None:
+        logits = jnp.where(mask[:, :, None], logits, _NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    return out.reshape(B, Sq, Hq, D)

@@ -1,0 +1,65 @@
+"""Rotary position embeddings (RoPE), including Llama-3 frequency scaling.
+
+Computed on the fly from ``position_ids`` — no precomputed cache buffer to
+shard.  Packing support falls out naturally: per-pack ``position_ids`` restart
+at 0 at each segment boundary (reference packed-sequence convention,
+``datasets/llm/packed_sequence.py:153-221``), and CP shards simply pass their
+global positions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float = 10000.0,
+    scaling: Optional[dict] = None,
+) -> np.ndarray:
+    """Inverse frequencies, with optional Llama-3-style scaling dict
+    (``rope_scaling`` from HF config.json: rope_type llama3 / linear / dynamic)."""
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    if scaling:
+        rope_type = scaling.get("rope_type", scaling.get("type", "default"))
+        if rope_type == "llama3":
+            factor = scaling["factor"]
+            low_factor = scaling["low_freq_factor"]
+            high_factor = scaling["high_freq_factor"]
+            old_len = scaling["original_max_position_embeddings"]
+            wavelen = 2 * np.pi / inv_freq
+            low_wavelen = old_len / low_factor
+            high_wavelen = old_len / high_factor
+            scaled = np.where(wavelen > low_wavelen, inv_freq / factor, inv_freq)
+            smooth = (old_len / wavelen - low_factor) / (high_factor - low_factor)
+            smoothed = (1 - smooth) / factor * inv_freq + smooth * inv_freq
+            is_medium = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+            inv_freq = np.where(is_medium, smoothed, scaled)
+        elif rope_type == "linear":
+            inv_freq = inv_freq / scaling["factor"]
+        # "default"/"dynamic" fall through (dynamic only matters for inference
+        # beyond trained context).
+    return inv_freq.astype(np.float32)
+
+
+def apply_rope(
+    q: jnp.ndarray,           # [B, S, Hq, D]
+    k: jnp.ndarray,           # [B, S, Hk, D]
+    position_ids: jnp.ndarray,  # [B, S]
+    inv_freq: jnp.ndarray,      # [D/2]
+):
+    """Rotate q and k by position-dependent phases (HF half-split convention:
+    the rotation pairs element i with element i + D/2)."""
+    angles = position_ids[..., None].astype(jnp.float32) * inv_freq  # [B, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B, S, 1, D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    def rot(x):
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        return out.astype(x.dtype)
+
+    return rot(q), rot(k)

@@ -1,0 +1,23 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's threaded-process-group trick for testing collectives
+without a cluster (SURVEY §4): real XLA collectives over 8 host-platform
+devices stand in for an 8-chip TPU slice.
+
+Note: this environment's sitecustomize registers the axon TPU plugin and
+forces ``jax_platforms=axon,cpu`` in every process, so setting the
+JAX_PLATFORMS env var is not enough — we must update the config after
+importing jax, before any backend initializes.
+"""
+
+import os
+
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,92 @@
+import os
+
+import pytest
+
+from automodel_tpu.config.arg_parser import parse_args_and_load_config, parse_cli_overrides
+from automodel_tpu.config.loader import (
+    ConfigNode,
+    load_yaml_config,
+    resolve_target,
+    translate_value,
+)
+
+YAML = """
+model:
+  _target_: automodel_tpu.models.gpt2.build_gpt2_model
+  n_layer: 2
+  n_embd: 32
+  n_head: 4
+  vocab_size: 64
+optimizer:
+  lr: 1.0e-4
+  betas: [0.9, 0.95]
+nested:
+  a:
+    b: 7
+flag: true
+"""
+
+
+@pytest.fixture
+def cfg_path(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text(YAML)
+    return str(p)
+
+
+def test_attribute_and_dotted_access(cfg_path):
+    cfg = load_yaml_config(cfg_path)
+    assert cfg.optimizer.lr == 1.0e-4
+    assert cfg.get("nested.a.b") == 7
+    assert "nested.a.b" in cfg
+    assert "nested.a.z" not in cfg
+    assert cfg.get("nested.a.z", 42) == 42
+    cfg.set_by_dotted("nested.a.c", 5)
+    assert cfg.nested.a.c == 5
+    cfg.set_by_dotted("brand.new.path", "x")
+    assert cfg.get("brand.new.path") == "x"
+
+
+def test_instantiate(cfg_path):
+    cfg = load_yaml_config(cfg_path)
+    model = cfg.model.instantiate()
+    assert model.config.n_layer == 2
+    assert model.config.vocab_size == 64
+    model2 = cfg.model.instantiate(n_layer=3)
+    assert model2.config.n_layer == 3
+
+
+def test_resolve_target_forms(tmp_path):
+    assert resolve_target("os.path.join") is os.path.join
+    f = tmp_path / "mod.py"
+    f.write_text("def fn():\n    return 99\n")
+    assert resolve_target(f"{f}:fn")() == 99
+    with pytest.raises(ImportError):
+        resolve_target("no.such.module.fn")
+
+
+def test_translate_value():
+    assert translate_value("1e-4") == 1e-4
+    assert translate_value("3") == 3
+    assert translate_value("[1, 2]") == [1, 2]
+    assert translate_value("true") is True
+    assert translate_value("none") is None
+    assert translate_value("hello") == "hello"
+
+
+def test_cli_overrides(cfg_path):
+    cfg = parse_args_and_load_config(
+        ["--config", cfg_path, "--optimizer.lr", "5e-5",
+         "--model.n_layer=4", "--new_flag"])
+    assert cfg.optimizer.lr == 5e-5
+    assert cfg.model.n_layer == 4
+    assert cfg.new_flag is True
+    assert parse_cli_overrides(["--a.b", "1", "--c=2", "--d"]) == [
+        ("a.b", 1), ("c", 2), ("d", True)]
+
+
+def test_to_dict_roundtrip(cfg_path):
+    cfg = load_yaml_config(cfg_path)
+    d = cfg.to_dict()
+    assert d["nested"] == {"a": {"b": 7}}
+    assert ConfigNode(d) == cfg

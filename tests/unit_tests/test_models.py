@@ -1,0 +1,105 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel, build_gpt2_model
+from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+TINY = dict(vocab_size=97, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2)
+
+
+@pytest.fixture(scope="module")
+def llama():
+    model = LlamaForCausalLM(LlamaConfig(**TINY), remat=False)
+    params = model.init(jax.random.key(0))
+    return model, params
+
+
+def test_llama_shapes(llama):
+    model, params = llama
+    ids = jax.random.randint(jax.random.key(1), (2, 16), 0, 97)
+    out = model(params, ids)
+    assert out["logits"].shape == (2, 16, 97)
+    hid = model(params, ids, return_hidden=True)
+    assert hid["hidden_states"].shape == (2, 16, 32)
+    assert hid["lm_head_kernel"].shape == (32, 97)
+
+
+def test_llama_causality(llama):
+    """Changing a future token must not change past logits."""
+    model, params = llama
+    ids = jnp.zeros((1, 8), jnp.int32)
+    ids2 = ids.at[0, 7].set(5)
+    l1 = model(params, ids)["logits"][0, :7].astype(jnp.float32)
+    l2 = model(params, ids2)["logits"][0, :7].astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
+
+
+def test_llama_segment_isolation(llama):
+    """With segment ids, tokens in segment 2 can't see segment 1."""
+    model, params = llama
+    key = jax.random.key(2)
+    a = jax.random.randint(key, (1, 4), 1, 97)
+    b = jax.random.randint(jax.random.key(3), (1, 4), 1, 97)
+    c = jax.random.randint(jax.random.key(4), (1, 4), 1, 97)
+    seg = jnp.array([[1, 1, 1, 1, 2, 2, 2, 2]])
+    pos = jnp.array([[0, 1, 2, 3, 0, 1, 2, 3]])
+    packed_ab = jnp.concatenate([a, b], 1)
+    packed_cb = jnp.concatenate([c, b], 1)
+    out_ab = model(params, packed_ab, position_ids=pos, segment_ids=seg)["logits"]
+    out_cb = model(params, packed_cb, position_ids=pos, segment_ids=seg)["logits"]
+    np.testing.assert_allclose(
+        np.asarray(out_ab[0, 4:].astype(jnp.float32)),
+        np.asarray(out_cb[0, 4:].astype(jnp.float32)), atol=1e-5)
+
+
+def test_llama_variants():
+    cfg = LlamaConfig(**TINY, attention_bias=True, qk_norm=True,
+                      tie_word_embeddings=False)
+    model = LlamaForCausalLM(cfg, remat=False)
+    params = model.init(jax.random.key(0))
+    assert "lm_head" in params
+    assert "bias" in params["layers"]["self_attn"]["q_proj"]
+    out = model(params, jnp.ones((1, 4), jnp.int32))
+    assert out["logits"].shape == (1, 4, 97)
+
+
+def test_llama_remat_matches():
+    cfg = LlamaConfig(**TINY)
+    m1 = LlamaForCausalLM(cfg, remat=False)
+    m2 = LlamaForCausalLM(cfg, remat=True)
+    params = m1.init(jax.random.key(0))
+    ids = jnp.ones((1, 8), jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(m1(params, ids)["logits"].astype(jnp.float32)),
+        np.asarray(m2(params, ids)["logits"].astype(jnp.float32)), atol=1e-5)
+
+
+def test_rope_scaling_llama3():
+    cfg = LlamaConfig(**TINY, rope_scaling={
+        "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+        "high_freq_factor": 4.0, "original_max_position_embeddings": 8192})
+    model = LlamaForCausalLM(cfg, remat=False)
+    params = model.init(jax.random.key(0))
+    out = model(params, jnp.ones((1, 4), jnp.int32))
+    assert np.isfinite(np.asarray(out["logits"], dtype=np.float32)).all()
+
+
+def test_gpt2_forward():
+    model = build_gpt2_model(n_layer=2, n_embd=32, n_head=4, vocab_size=64,
+                             n_positions=32, remat=False)
+    params = model.init(jax.random.key(0))
+    out = model(params, jnp.ones((2, 8), jnp.int32))
+    assert out["logits"].shape == (2, 8, 64)
+
+
+def test_hf_config_ingestion():
+    hf = {"model_type": "qwen2", "vocab_size": 64, "hidden_size": 32,
+          "intermediate_size": 48, "num_hidden_layers": 2,
+          "num_attention_heads": 4, "num_key_value_heads": 4,
+          "unknown_field": "zzz"}
+    cfg = LlamaConfig.from_hf_config(hf)
+    assert cfg.attention_bias is True  # qwen2 default
+    assert cfg.vocab_size == 64
