@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from automodel_tpu.distributed.shardings import constrain
 from automodel_tpu.ops.attention import dot_product_attention
 from automodel_tpu.ops.norms import layer_norm
 
@@ -83,6 +84,34 @@ class GPT2LMHeadModel:
     def abstract_params(self):
         return jax.eval_shape(self.init, jax.random.key(0))
 
+    def param_axes(self) -> Dict[str, Any]:
+        """Logical axis names per param (see ``llama.LlamaForCausalLM.param_axes``)."""
+        cfg = self.config
+        axes: Dict[str, Any] = {
+            "wte": {"embedding": ("vocab", "embed")},
+            "wpe": {"embedding": ("pos", "embed")},
+            "h": {
+                "ln_1": {"weight": ("layers", "norm"), "bias": ("layers", "norm")},
+                "attn": {
+                    "c_attn": {"kernel": ("layers", "embed", "qkv3"),
+                               "bias": ("layers", "qkv3")},
+                    "c_proj": {"kernel": ("layers", "heads", "embed"),
+                               "bias": ("layers", "norm")},
+                },
+                "ln_2": {"weight": ("layers", "norm"), "bias": ("layers", "norm")},
+                "mlp": {
+                    "c_fc": {"kernel": ("layers", "embed", "mlp"),
+                             "bias": ("layers", "mlp")},
+                    "c_proj": {"kernel": ("layers", "mlp", "embed"),
+                               "bias": ("layers", "norm")},
+                },
+            },
+            "ln_f": {"weight": ("norm",), "bias": ("norm",)},
+        }
+        if not cfg.tie_word_embeddings:
+            axes["lm_head"] = {"kernel": ("embed", "vocab")}
+        return axes
+
     def _block(self, hidden, p, segment_ids, attention_mask):
         cfg = self.config
         B, S, H = hidden.shape
@@ -104,7 +133,7 @@ class GPT2LMHeadModel:
         x = layer_norm(hidden, p["ln_2"]["weight"], p["ln_2"]["bias"], eps)
         x = jax.nn.gelu(x @ p["mlp"]["c_fc"]["kernel"].astype(cd) + p["mlp"]["c_fc"]["bias"].astype(cd))
         x = x @ p["mlp"]["c_proj"]["kernel"].astype(cd) + p["mlp"]["c_proj"]["bias"].astype(cd)
-        return hidden + x
+        return constrain(hidden + x, ("act_batch", "act_seq", "act_embed"))
 
     def __call__(self, params, input_ids, position_ids=None, segment_ids=None,
                  attention_mask=None, return_hidden: bool = False):

@@ -234,62 +234,69 @@ def save_hf_weights(
             arr = np.asarray(jax.device_get(v))
         return arr.astype(save_dtype) if save_dtype is not None else arr
 
-    # Expand stacked params to per-layer HF tensors, lazily.
-    entries: List[Tuple[str, Callable[[], np.ndarray]]] = []
+    # Expand stacked params to per-layer HF tensors, lazily, with byte sizes
+    # known up-front from shapes — so shard assignment (and the
+    # model-xxxxx-of-xxxxx total) is planned before anything materializes.
+    entries: List[Tuple[str, int, Callable[[], np.ndarray]]] = []
     for path, value in flat.items():
         spec = key_map.get(path)
         if spec is None:
             raise KeyError(f"No HF mapping for param {'/'.join(path)}")
+        itemsize = (save_dtype or np.dtype(str(value.dtype))).itemsize
 
         if spec.stacked:
-            n_layers = value.shape[0]
-            for i in range(n_layers):
+            per_layer = int(np.prod(value.shape[1:])) * itemsize
+            for i in range(value.shape[0]):
                 def layer_fn(v=value, i=i, spec=spec):
                     arr = materialize(v[i])
-                    return arr.T if spec.transpose else arr
-                entries.append((spec.template.format(i=i), layer_fn))
+                    # safetensors serializes the raw buffer, ignoring strides:
+                    # a transposed *view* would save the untransposed data.
+                    return np.ascontiguousarray(arr.T) if spec.transpose else arr
+                entries.append((spec.template.format(i=i), per_layer, layer_fn))
         else:
             def full_fn(v=value, spec=spec):
                 arr = materialize(v)
-                return arr.T if spec.transpose else arr
-            entries.append((spec.template, full_fn))
+                return np.ascontiguousarray(arr.T) if spec.transpose else arr
+            entries.append(
+                (spec.template, int(np.prod(value.shape)) * itemsize, full_fn))
+
+    # Greedy shard plan by byte budget.
+    shard_plan: List[List[Tuple[str, Callable[[], np.ndarray]]]] = [[]]
+    cur_bytes = 0
+    for name, nbytes, fn in entries:
+        if shard_plan[-1] and cur_bytes + nbytes > max_shard_bytes:
+            shard_plan.append([])
+            cur_bytes = 0
+        shard_plan[-1].append((name, fn))
+        cur_bytes += nbytes
 
     if is_writer:
         os.makedirs(out_dir, exist_ok=True)
 
-    # Greedy sharding by byte budget, materializing one tensor at a time.
-    # All processes run the loop (the gathers are collective); only process 0
-    # keeps the arrays and writes files.
-    final_shards: List[Dict[str, np.ndarray]] = []
-    cur: Dict[str, np.ndarray] = {}
-    cur_bytes = 0
-    for name, fn in entries:
-        arr = fn()
-        if not is_writer:
-            continue
-        if cur and cur_bytes + arr.nbytes > max_shard_bytes:
-            final_shards.append(cur)
-            cur, cur_bytes = {}, 0
-        cur[name] = arr
-        cur_bytes += arr.nbytes
-    if cur:
-        final_shards.append(cur)
-    if not is_writer:
-        return
-
-    n = len(final_shards)
+    # Materialize and write one shard at a time: peak host RAM is one shard,
+    # not the whole model.  All processes run the loop (the gathers are
+    # collective); only process 0 keeps arrays and writes files.
+    n = len(shard_plan)
     weight_map: Dict[str, str] = {}
     total = 0
-    for i, shard in enumerate(final_shards):
+    for i, shard_entries in enumerate(shard_plan):
         fname = (
             "model.safetensors" if n == 1
             else f"model-{i + 1:05d}-of-{n:05d}.safetensors"
         )
-        save_file(shard, os.path.join(out_dir, fname),
-                  metadata={"format": "pt"})
-        for k, v in shard.items():
-            weight_map[k] = fname
-            total += v.nbytes
+        shard: Dict[str, np.ndarray] = {}
+        for name, fn in shard_entries:
+            arr = fn()
+            if is_writer:
+                shard[name] = arr
+                weight_map[name] = fname
+                total += arr.nbytes
+        if is_writer:
+            save_file(shard, os.path.join(out_dir, fname),
+                      metadata={"format": "pt"})
+        del shard
+    if not is_writer:
+        return
     with open(os.path.join(out_dir, SAFETENSORS_INDEX), "w") as f:
         json.dump(
             {"metadata": {"total_size": total}, "weight_map": weight_map},

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from automodel_tpu.distributed.shardings import constrain
 from automodel_tpu.ops.attention import dot_product_attention
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.rotary import apply_rope, rope_frequencies
@@ -135,6 +136,42 @@ class LlamaForCausalLM:
     def abstract_params(self) -> Dict[str, Any]:
         return jax.eval_shape(self.init, jax.random.key(0))
 
+    def param_axes(self) -> Dict[str, Any]:
+        """Logical axis names per param (consumed by
+        ``automodel_tpu.distributed.shardings``) — the TP/FSDP plan as data,
+        replacing the reference's per-model DTensor plan registry
+        (``distributed/optimized_tp_plans.py:235-243``)."""
+        cfg = self.config
+        attn: Dict[str, Any] = {
+            "q_proj": {"kernel": ("layers", "embed", "heads")},
+            "k_proj": {"kernel": ("layers", "embed", "heads")},
+            "v_proj": {"kernel": ("layers", "embed", "heads")},
+            "o_proj": {"kernel": ("layers", "heads", "embed")},
+        }
+        if cfg.attention_bias:
+            for proj in ("q_proj", "k_proj", "v_proj"):
+                attn[proj]["bias"] = ("layers", "heads")
+        if cfg.qk_norm:
+            attn["q_norm"] = {"weight": ("layers", "head_dim")}
+            attn["k_norm"] = {"weight": ("layers", "head_dim")}
+        axes: Dict[str, Any] = {
+            "embed_tokens": {"embedding": ("vocab", "embed")},
+            "layers": {
+                "input_layernorm": {"weight": ("layers", "norm")},
+                "self_attn": attn,
+                "post_attention_layernorm": {"weight": ("layers", "norm")},
+                "mlp": {
+                    "gate_proj": {"kernel": ("layers", "embed", "mlp")},
+                    "up_proj": {"kernel": ("layers", "embed", "mlp")},
+                    "down_proj": {"kernel": ("layers", "mlp", "embed")},
+                },
+            },
+            "norm": {"weight": ("norm",)},
+        }
+        if not cfg.tie_word_embeddings:
+            axes["lm_head"] = {"kernel": ("embed", "vocab")}
+        return axes
+
     # -- forward -----------------------------------------------------------
     def _decoder_layer(self, hidden, layer_params, position_ids, segment_ids,
                        attention_mask, inv_freq):
@@ -175,7 +212,8 @@ class LlamaForCausalLM:
         gate = x @ p["mlp"]["gate_proj"]["kernel"].astype(cd)
         up = x @ p["mlp"]["up_proj"]["kernel"].astype(cd)
         down = (jax.nn.silu(gate) * up) @ p["mlp"]["down_proj"]["kernel"].astype(cd)
-        return resid + down
+        # SP/CP activation layout between blocks (no-op without a sharding ctx)
+        return constrain(resid + down, ("act_batch", "act_seq", "act_embed"))
 
     def __call__(
         self,
@@ -195,6 +233,7 @@ class LlamaForCausalLM:
             position_ids = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
 
         hidden = params["embed_tokens"]["embedding"][input_ids].astype(self.compute_dtype)
+        hidden = constrain(hidden, ("act_batch", "act_seq", "act_embed"))
         inv_freq = jnp.asarray(self.inv_freq)
 
         def body(h, layer_params):
@@ -218,7 +257,7 @@ class LlamaForCausalLM:
         if return_hidden:
             return {"hidden_states": hidden, "lm_head_kernel": lm_kernel}
         logits = hidden @ lm_kernel.astype(self.compute_dtype)
-        return {"logits": logits}
+        return {"logits": constrain(logits, ("act_batch", "act_seq_nosp", "act_vocab"))}
 
     @property
     def num_params(self) -> int:

@@ -1,0 +1,6 @@
+from automodel_tpu.optim.builder import (  # noqa: F401
+    build_optimizer,
+    get_hyperparam,
+    set_hyperparams,
+)
+from automodel_tpu.optim.scheduler import OptimizerParamScheduler  # noqa: F401

@@ -1,0 +1,127 @@
+"""Optimizer construction: YAML ``_target_`` surface over optax.
+
+The reference points ``optimizer._target_`` at ``torch.optim.AdamW`` etc.
+(``examples/llm_finetune/llama3_2/llama3_2_1b_hellaswag.yaml:84-90``); the TPU
+equivalent is :func:`build_optimizer`, which accepts the same torch-style
+kwarg names (``lr``, ``betas``, ``eps``, ``weight_decay``, ``foreach``/
+``fused`` ignored) and returns an optax ``GradientTransformation`` wrapped in
+``optax.inject_hyperparams`` so the LR/WD schedule can be driven per-step
+from host-side state without recompiling the train step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import optax
+
+_IGNORED_TORCH_KWARGS = {
+    "foreach", "fused", "capturable", "maximize", "differentiable", "amsgrad",
+}
+
+
+def build_optimizer(
+    name: str = "adamw",
+    lr: float = 1e-4,
+    betas: Sequence[float] = (0.9, 0.999),
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    momentum: float = 0.9,
+    grad_clip_norm: Optional[float] = None,
+    mask: Optional[Any] = None,
+    **kwargs,
+) -> optax.GradientTransformation:
+    """Build an injectable-hyperparam optax optimizer.
+
+    ``mask``: optional trainable-mask pytree (PEFT: True = trainable) applied
+    with ``optax.masked`` so frozen params receive zero updates.
+    ``grad_clip_norm``: when set, global-norm clipping is fused into the
+    optimizer chain (the reference clips separately at
+    ``recipes/llm/train_ft.py:689-698``; keeping it in-chain lets the whole
+    update stay one XLA program).
+    """
+    for k in list(kwargs):
+        if k in _IGNORED_TORCH_KWARGS:
+            kwargs.pop(k)
+    b1, b2 = float(betas[0]), float(betas[1])
+    name = name.lower().replace("torch.optim.", "")
+
+    @optax.inject_hyperparams
+    def make(learning_rate, weight_decay):
+        chain = []
+        if grad_clip_norm:
+            chain.append(optax.clip_by_global_norm(float(grad_clip_norm)))
+        if name in ("adamw", "adam"):
+            chain.append(optax.scale_by_adam(b1=b1, b2=b2, eps=float(eps)))
+            if name == "adamw":
+                chain.append(optax.add_decayed_weights(weight_decay))
+        elif name == "sgd":
+            # torch.optim.SGD couples wd into the gradient *before* the
+            # momentum buffer (d_p += wd*p, then buf = m*buf + d_p).
+            if weight_decay is not None:
+                chain.append(optax.add_decayed_weights(weight_decay))
+            if momentum:
+                chain.append(optax.trace(decay=float(momentum)))
+        elif name == "adafactor":
+            return optax.adafactor(learning_rate=learning_rate)
+        else:
+            raise ValueError(f"Unknown optimizer {name!r}")
+        chain.append(optax.scale_by_learning_rate(learning_rate))
+        return optax.chain(*chain)
+
+    tx = make(learning_rate=float(lr), weight_decay=float(weight_decay))
+    if mask is not None:
+        # optax.masked passes non-masked grads through *unchanged*; frozen
+        # params must get explicit zero updates (PEFT base freeze,
+        # reference _peft/lora.py:322-363).
+        import jax as _jax
+
+        inverse = _jax.tree.map(lambda b: not b, mask)
+        tx = optax.chain(
+            optax.masked(tx, mask),
+            optax.masked(optax.set_to_zero(), inverse),
+        )
+    return tx
+
+
+def set_hyperparams(opt_state: Any, lr: Optional[float] = None,
+                    wd: Optional[float] = None) -> Any:
+    """Return ``opt_state`` with updated injected hyperparameters.
+
+    Host-side replacement of the two scalar leaves — the jitted step sees them
+    as ordinary dynamic inputs, so this never recompiles (the TPU analogue of
+    the reference mutating ``param_group["lr"]``, ``optim/scheduler.py:206-218``).
+    """
+    import jax.numpy as jnp
+
+    def _update(state):
+        if type(state) in (tuple, list):  # optax.chain state (not a namedtuple)
+            return type(state)(_update(s) for s in state)
+        if hasattr(state, "hyperparams"):
+            hp = dict(state.hyperparams)
+            if lr is not None and "learning_rate" in hp:
+                hp["learning_rate"] = jnp.asarray(
+                    lr, dtype=jnp.asarray(hp["learning_rate"]).dtype)
+            if wd is not None and "weight_decay" in hp:
+                hp["weight_decay"] = jnp.asarray(
+                    wd, dtype=jnp.asarray(hp["weight_decay"]).dtype)
+            return state._replace(hyperparams=hp)
+        if hasattr(state, "inner_state"):  # optax.masked wrapper
+            return state._replace(inner_state=_update(state.inner_state))
+        return state
+
+    return _update(opt_state)
+
+
+def get_hyperparam(opt_state: Any, key: str = "learning_rate"):
+    if type(opt_state) in (tuple, list):
+        for s in opt_state:
+            v = get_hyperparam(s, key)
+            if v is not None:
+                return v
+        return None
+    if hasattr(opt_state, "hyperparams"):
+        return opt_state.hyperparams.get(key)
+    if hasattr(opt_state, "inner_state"):
+        return get_hyperparam(opt_state.inner_state, key)
+    return None

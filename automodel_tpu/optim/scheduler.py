@@ -1,0 +1,213 @@
+"""Megatron-style optimizer parameter scheduler (LR + weight-decay annealing).
+
+Reference parity: ``nemo_automodel/components/optim/scheduler.py:14-313``
+(warmup + {constant, linear, cosine, inverse-square-root, WSD} decay, wd
+increment schedules, checkpoint round-trip with override/constancy checks).
+
+TPU-native shape: the scheduler is **host-side pure math over an integer step
+count** — the jitted train step receives ``lr``/``wd`` as dynamic scalars via
+``optax.inject_hyperparams`` state, so stepping the schedule never triggers a
+recompile and the schedule itself stays trivially checkpointable.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class OptimizerParamScheduler:
+    """Anneals learning rate and weight decay as a function of step count.
+
+    Unlike the reference, no optimizer object is mutated: call
+    :meth:`get_lr`/:meth:`get_wd` (or read :attr:`current_lr` after
+    :meth:`step`) and feed the values into the train step.
+    """
+
+    def __init__(
+        self,
+        optimizer=None,  # accepted for YAML signature parity; unused
+        init_lr: float = 0.0,
+        max_lr: float = 1e-4,
+        min_lr: float = 0.0,
+        lr_warmup_steps: int = 0,
+        lr_decay_steps: int = 1,
+        lr_decay_style: str = "constant",
+        start_wd: float = 0.0,
+        end_wd: float = 0.0,
+        wd_incr_steps: int = 0,
+        wd_incr_style: str = "constant",
+        use_checkpoint_opt_param_scheduler: Optional[bool] = True,
+        override_opt_param_scheduler: Optional[bool] = False,
+        wsd_decay_steps: Optional[int] = None,
+        lr_wsd_decay_style: Optional[str] = None,
+    ) -> None:
+        self.init_lr = init_lr
+        self.max_lr = float(max_lr)
+        self.min_lr = min_lr
+        assert self.min_lr >= 0.0
+        assert self.max_lr >= self.min_lr
+        assert self.init_lr <= self.max_lr
+
+        self.lr_warmup_steps = lr_warmup_steps
+        self.num_steps = 0
+        self.lr_decay_steps = lr_decay_steps
+        self.wsd_decay_steps = wsd_decay_steps
+        self.lr_wsd_decay_style = lr_wsd_decay_style
+        assert self.lr_decay_steps > 0
+        assert self.lr_warmup_steps < self.lr_decay_steps
+
+        self.lr_decay_style = lr_decay_style
+        if self.lr_decay_style == "WSD":
+            assert self.wsd_decay_steps is not None
+
+        self.start_wd = start_wd
+        self.end_wd = end_wd
+        assert self.start_wd >= 0.0
+        assert self.end_wd >= self.start_wd
+        self.wd_incr_steps = wd_incr_steps
+        self.wd_incr_style = wd_incr_style
+
+        self.override_opt_param_scheduler = override_opt_param_scheduler
+        self.use_checkpoint_opt_param_scheduler = use_checkpoint_opt_param_scheduler
+        if self.override_opt_param_scheduler:
+            assert not self.use_checkpoint_opt_param_scheduler, (
+                "both override and use-checkpoint are set.")
+        self.step(0)
+
+    # -- schedules ---------------------------------------------------------
+    def get_wd(self) -> float:
+        if self.wd_incr_steps <= 0 or self.num_steps > self.wd_incr_steps:
+            return self.end_wd
+        if self.wd_incr_style == "constant":
+            assert self.start_wd == self.end_wd
+            return self.end_wd
+        incr_ratio = float(self.num_steps) / float(self.wd_incr_steps)
+        delta_wd = self.end_wd - self.start_wd
+        if self.wd_incr_style == "linear":
+            coeff = incr_ratio
+        elif self.wd_incr_style == "cosine":
+            coeff = 0.5 * (math.cos(math.pi * (1 - incr_ratio)) + 1.0)
+        else:
+            raise ValueError(
+                f"{self.wd_incr_style} weight decay increment style is not supported.")
+        return self.start_wd + coeff * delta_wd
+
+    def get_lr(self, max_lr: Optional[float] = None,
+               min_lr: Optional[float] = None) -> float:
+        """LR at the current step (decay functions from the Goyal et al. /
+        Megatron family; reference ``optim/scheduler.py:143-204``)."""
+        max_lr = self.max_lr if max_lr is None else max_lr
+        min_lr = self.min_lr if min_lr is None else min_lr
+
+        if self.lr_warmup_steps > 0 and self.num_steps <= self.lr_warmup_steps:
+            return self.init_lr + (
+                (max_lr - self.init_lr) * float(self.num_steps)
+                / float(self.lr_warmup_steps))
+        if self.lr_decay_style == "constant":
+            return max_lr
+        if self.num_steps > self.lr_decay_steps:
+            return min_lr
+        if self.lr_decay_style == "inverse-square-root":
+            warmup_steps = max(self.lr_warmup_steps, 1)
+            num_steps = max(self.num_steps, 1)
+            return max(min_lr, max_lr * warmup_steps ** 0.5 / num_steps ** 0.5)
+
+        num_steps_ = self.num_steps - self.lr_warmup_steps
+        decay_steps_ = self.lr_decay_steps - self.lr_warmup_steps
+        decay_ratio = float(num_steps_) / float(decay_steps_)
+        delta_lr = max_lr - min_lr
+        if self.lr_decay_style == "linear":
+            coeff = 1.0 - decay_ratio
+        elif self.lr_decay_style == "cosine":
+            coeff = 0.5 * (math.cos(math.pi * decay_ratio) + 1.0)
+        elif self.lr_decay_style == "WSD":
+            wsd_anneal_start_ = self.lr_decay_steps - self.wsd_decay_steps
+            if self.num_steps <= wsd_anneal_start_:
+                coeff = 1.0
+            else:
+                wsd_steps = self.num_steps - wsd_anneal_start_
+                r = float(wsd_steps) / float(self.wsd_decay_steps)
+                if self.lr_wsd_decay_style == "linear":
+                    coeff = 1.0 - r
+                elif self.lr_wsd_decay_style == "cosine":
+                    coeff = 0.5 * (math.cos(math.pi * r) + 1.0)
+                elif self.lr_wsd_decay_style == "exponential":
+                    coeff = (2.0 * math.pow(0.5, r)) - 1.0
+                elif self.lr_wsd_decay_style == "minus_sqrt":
+                    coeff = 1.0 - math.sqrt(r)
+                else:
+                    raise ValueError(
+                        f"{self.lr_wsd_decay_style} WSD decay style is not supported.")
+        else:
+            raise ValueError(
+                f"{self.lr_decay_style} decay style is not supported.")
+        return min_lr + coeff * delta_lr
+
+    # -- stepping ----------------------------------------------------------
+    def step(self, increment: int = 1) -> None:
+        self.num_steps += increment
+        self.current_wd = self.get_wd()
+        self.current_lr = self.get_lr()
+
+    # -- checkpoint round-trip --------------------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "max_lr": self.max_lr,
+            "lr_warmup_steps": self.lr_warmup_steps,
+            "num_steps": self.num_steps,
+            "lr_decay_style": self.lr_decay_style,
+            "lr_decay_steps": self.lr_decay_steps,
+            "min_lr": self.min_lr,
+            "start_wd": self.start_wd,
+            "end_wd": self.end_wd,
+            "wd_incr_style": self.wd_incr_style,
+            "wd_incr_steps": self.wd_incr_steps,
+        }
+
+    def _check_and_set(self, cls_value, sd_value, name: str):
+        if self.override_opt_param_scheduler:
+            logger.info("overriding %s value to %s", name, cls_value)
+            return cls_value
+        if not self.use_checkpoint_opt_param_scheduler:
+            assert cls_value == sd_value, (
+                f"OptimizerParamScheduler: class input value {cls_value} and "
+                f"checkpoint value {sd_value} for {name} do not match")
+        return sd_value
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        # Legacy Megatron key aliases handled for parity
+        # (reference optim/scheduler.py:260-313).
+        max_lr_ = state_dict.get("start_lr", state_dict.get("max_lr"))
+        self.max_lr = self._check_and_set(self.max_lr, max_lr_, "learning rate")
+        self.min_lr = self._check_and_set(
+            self.min_lr, state_dict["min_lr"], "minimum learning rate")
+        warm = state_dict.get(
+            "warmup_iter", state_dict.get("warmup_steps",
+                                          state_dict.get("lr_warmup_steps")))
+        self.lr_warmup_steps = self._check_and_set(
+            self.lr_warmup_steps, warm, "warmup iterations")
+        decay = state_dict.get(
+            "end_iter", state_dict.get("decay_steps",
+                                       state_dict.get("lr_decay_steps")))
+        self.lr_decay_steps = self._check_and_set(
+            self.lr_decay_steps, decay, "total number of iterations")
+        style = state_dict.get("decay_style", state_dict.get("lr_decay_style"))
+        self.lr_decay_style = self._check_and_set(
+            self.lr_decay_style, style, "learning rate decay style")
+        self.num_steps = 0
+        self.step(state_dict.get("num_iters", state_dict.get("num_steps", 0)))
+        if "start_wd" in state_dict:
+            self.start_wd = self._check_and_set(
+                self.start_wd, state_dict["start_wd"], "start weight decay")
+            self.end_wd = self._check_and_set(
+                self.end_wd, state_dict["end_wd"], "end weight decay")
+            self.wd_incr_steps = self._check_and_set(
+                self.wd_incr_steps, state_dict["wd_incr_steps"],
+                "total number of weight decay iterations")
+            self.wd_incr_style = self._check_and_set(
+                self.wd_incr_style, state_dict["wd_incr_style"],
+                "weight decay incr style")

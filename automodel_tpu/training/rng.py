@@ -1,0 +1,87 @@
+"""Stateful RNG: one seed drives python/numpy/JAX streams, checkpointable.
+
+Reference parity: ``nemo_automodel/components/training/rng.py:21-99``
+(``StatefulRNG`` seeds python/numpy/torch with optional rank offset and
+save/restores on context exit).  The JAX stream is a counted key-fold:
+``key_for(step)`` = ``fold_in(base_key, step)``, so resuming at step N
+reproduces the exact dropout/init randomness without replaying N steps.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+class StatefulRNG:
+    def __init__(self, seed: int = 42, ranked: bool = False):
+        self.seed = int(seed)
+        self.ranked = bool(ranked)
+        offset = jax.process_index() if ranked else 0
+        self._effective_seed = self.seed + offset
+        self._fold_count = 0
+        self._saved = None
+        self._apply()
+
+    def _apply(self) -> None:
+        random.seed(self._effective_seed)
+        np.random.seed(self._effective_seed % (2 ** 32))
+        self.base_key = jax.random.key(self._effective_seed)
+
+    # -- JAX key stream ----------------------------------------------------
+    def key_for(self, *stream: int) -> jax.Array:
+        """Deterministic key for (step, microbatch, ...) coordinates."""
+        k = self.base_key
+        for s in stream:
+            k = jax.random.fold_in(k, int(s))
+        return k
+
+    def next_key(self) -> jax.Array:
+        self._fold_count += 1
+        return self.key_for(self._fold_count)
+
+    # -- context manager (save/restore host RNG states) --------------------
+    def __enter__(self):
+        self._saved = (random.getstate(), np.random.get_state())
+        self._apply()
+        return self
+
+    def __exit__(self, *exc):
+        if self._saved is not None:
+            random.setstate(self._saved[0])
+            np.random.set_state(self._saved[1])
+            self._saved = None
+        return False
+
+    # -- state round-trip --------------------------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "ranked": self.ranked,
+            "fold_count": self._fold_count,
+            "py_random": random.getstate(),
+            "np_random": np.random.get_state(),
+        }
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.seed = sd["seed"]
+        self.ranked = sd["ranked"]
+        offset = jax.process_index() if self.ranked else 0
+        self._effective_seed = self.seed + offset
+        self._fold_count = sd.get("fold_count", 0)
+        self.base_key = jax.random.key(self._effective_seed)
+        if "py_random" in sd:
+            state = sd["py_random"]
+            if isinstance(state, list):  # json round-trip turns tuples to lists
+                state = tuple(
+                    tuple(s) if isinstance(s, list) else s for s in state)
+            random.setstate(state)
+        if "np_random" in sd:
+            state = sd["np_random"]
+            if isinstance(state, list):
+                state = tuple(
+                    np.asarray(s) if isinstance(s, list) else s for s in state)
+            np.random.set_state(state)

@@ -1,0 +1,104 @@
+"""Step scheduler: groups dataloader batches into grad-accumulation windows.
+
+Reference parity: ``nemo_automodel/components/training/step_scheduler.py:20-165``
+— ``grad_acc_steps = global_batch_size / (local_batch_size * dp_size)``,
+iteration yields *lists of microbatches* per optimizer step, checkpoint /
+validation cadence flags, and a ``{step, epoch}`` state round-trip.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Optional
+
+
+class StepScheduler:
+    """Yields lists of ``grad_acc_steps`` microbatches per optimizer step."""
+
+    def __init__(
+        self,
+        grad_acc_steps: Optional[int] = None,
+        ckpt_every_steps: int = 100,
+        dataloader: Optional[Any] = None,
+        val_every_steps: Optional[int] = None,
+        num_epochs: int = 1,
+        max_steps: Optional[int] = None,
+        global_batch_size: Optional[int] = None,
+        local_batch_size: Optional[int] = None,
+        dp_size: int = 1,
+    ) -> None:
+        if grad_acc_steps is None:
+            if global_batch_size is None or local_batch_size is None:
+                grad_acc_steps = 1
+            else:
+                denom = local_batch_size * max(dp_size, 1)
+                if global_batch_size % denom:
+                    raise ValueError(
+                        f"global_batch_size {global_batch_size} not divisible "
+                        f"by local_batch_size*dp_size {denom}")
+                grad_acc_steps = global_batch_size // denom
+        self.grad_acc_steps = max(int(grad_acc_steps), 1)
+        self.ckpt_every_steps = ckpt_every_steps
+        self.val_every_steps = val_every_steps
+        self.num_epochs = num_epochs
+        self.max_steps = max_steps
+        self.dataloader = dataloader
+        self.step = 0          # optimizer steps taken (global, monotonic)
+        self.epoch = 0
+        self._epoch_exhausted = False
+
+    # -- iteration ---------------------------------------------------------
+    def set_dataloader(self, dataloader: Any) -> None:
+        self.dataloader = dataloader
+
+    @property
+    def epochs(self) -> Iterator[int]:
+        start = self.epoch
+        for e in range(start, self.num_epochs):
+            self.epoch = e
+            yield e
+
+    def __iter__(self) -> Iterator[List[Any]]:
+        """Iterate optimizer steps for the current epoch; each item is a list
+        of ``grad_acc_steps`` microbatches (last partial group is dropped,
+        matching DistributedSampler drop-last semantics)."""
+        assert self.dataloader is not None, "set_dataloader first"
+        self._epoch_exhausted = False
+        group: List[Any] = []
+        for batch in self.dataloader:
+            group.append(batch)
+            if len(group) == self.grad_acc_steps:
+                self.step += 1
+                yield group
+                group = []
+                if self.max_steps is not None and self.step >= self.max_steps:
+                    return
+        self._epoch_exhausted = True
+
+    # -- cadence flags (reference step_scheduler.py:113-147) ---------------
+    @property
+    def is_optim_step(self) -> bool:
+        return True  # grouping already guarantees a full grad-acc window
+
+    @property
+    def is_ckpt_step(self) -> bool:
+        if self.ckpt_every_steps and self.step % self.ckpt_every_steps == 0:
+            return True
+        return bool(self._epoch_exhausted) or (
+            self.max_steps is not None and self.step >= self.max_steps)
+
+    @property
+    def is_val_step(self) -> bool:
+        return bool(self.val_every_steps) and (
+            self.step % self.val_every_steps == 0)
+
+    @property
+    def finished(self) -> bool:
+        return self.max_steps is not None and self.step >= self.max_steps
+
+    # -- state round-trip --------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"step": self.step, "epoch": self.epoch}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.step = sd["step"]
+        self.epoch = sd["epoch"]

@@ -1,0 +1,125 @@
+"""Megatron-style named timers — the framework's profiling subsystem.
+
+Reference parity: ``nemo_automodel/components/training/timers.py:152-558``
+(log levels, optional barriers, max/minmax/all-rank reports, wandb writer).
+On TPU a "barrier" is ``jax.block_until_ready`` on a trivial device op —
+device work is async, so un-barriered timers measure dispatch, barriered
+timers measure real step latency.  ``jax.profiler`` trace capture is exposed
+via :func:`trace` for xplane-level analysis (the nsys equivalent).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self._start: Optional[float] = None
+        self._elapsed = 0.0
+        self._history: List[float] = []
+
+    def start(self, barrier: bool = False) -> None:
+        assert self._start is None, f"timer {self.name} already started"
+        if barrier:
+            _device_barrier()
+        self._start = time.perf_counter()
+
+    def stop(self, barrier: bool = False) -> None:
+        assert self._start is not None, f"timer {self.name} not started"
+        if barrier:
+            _device_barrier()
+        dt = time.perf_counter() - self._start
+        self._elapsed += dt
+        self._history.append(dt)
+        self._start = None
+
+    def elapsed(self, reset: bool = True) -> float:
+        active = self._start is not None
+        if active:
+            self.stop()
+        out = self._elapsed
+        if reset:
+            self._elapsed = 0.0
+        if active:
+            self.start()
+        return out
+
+    def mean(self) -> float:
+        return float(np.mean(self._history)) if self._history else 0.0
+
+    def reset(self) -> None:
+        self._elapsed = 0.0
+        self._history.clear()
+
+
+def _device_barrier() -> None:
+    jax.block_until_ready(
+        jax.device_put(np.zeros(()), jax.devices()[0]))
+
+
+class Timers:
+    """``timers("fwd", log_level=1).start(); ...; timers("fwd").stop()``"""
+
+    def __init__(self, log_level: int = 2, log_option: str = "minmax"):
+        self.log_level = log_level
+        self.log_option = log_option
+        self._timers: Dict[str, _Timer] = {}
+        self._log_levels: Dict[str, int] = {}
+
+    def __call__(self, name: str, log_level: Optional[int] = None) -> _Timer:
+        if name not in self._timers:
+            self._timers[name] = _Timer(name)
+            self._log_levels[name] = (
+                log_level if log_level is not None else self.log_level)
+        return self._timers[name]
+
+    @contextlib.contextmanager
+    def record(self, name: str, barrier: bool = False):
+        t = self(name)
+        t.start(barrier=barrier)
+        try:
+            yield t
+        finally:
+            t.stop(barrier=barrier)
+
+    def get_elapsed(self, names: Optional[List[str]] = None,
+                    reset: bool = True, normalizer: float = 1.0) -> Dict[str, float]:
+        names = names if names is not None else list(self._timers)
+        return {
+            n: self._timers[n].elapsed(reset=reset) / normalizer
+            for n in names if n in self._timers
+        }
+
+    def log(self, names: Optional[List[str]] = None, reset: bool = True,
+            normalizer: float = 1.0, logger=None) -> str:
+        elapsed = self.get_elapsed(names, reset=reset, normalizer=normalizer)
+        msg = "time (ms)" + "".join(
+            f" | {n}: {v * 1000.0:.2f}" for n, v in elapsed.items())
+        if logger is not None:
+            logger.info(msg)
+        return msg
+
+    def write(self, names: List[str], writer, iteration: int,
+              reset: bool = True, normalizer: float = 1.0) -> None:
+        """Write timer values to a wandb-style writer (reference
+        ``timers.py:473-538``)."""
+        for n, v in self.get_elapsed(names, reset=reset,
+                                     normalizer=normalizer).items():
+            writer.log({f"timers/{n}": v}, step=iteration)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """jax.profiler trace capture (xplane) around a code block."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,192 @@
+"""The jitted train/eval step: one XLA program per optimizer step.
+
+TPU-native collapse of the reference's eager hot loop
+(``nemo_automodel/recipes/llm/train_ft.py:630-731``): where PyTorch needs
+``no_sync`` contexts, explicit H2D copies, DDP loss scaling and a separate
+clip/optimizer/scheduler sequence, here **grad accumulation is a
+``lax.scan`` over microbatches inside one jit** — XLA overlaps the FSDP
+all-gathers/reduce-scatters with compute, grads are accumulated in fp32, and
+the optimizer update runs sharded in the same program.
+
+Loss convention (framework-wide, reference ``loss/masked_ce.py:20-76`` +
+``train_ft.py:425-474``): per-microbatch losses are **sums** of token CE;
+the final division is by the **global** label-token count of the whole
+optimizer step (all microbatches, all dp/cp shards) — under jit the batch is
+a global array, so a plain ``jnp.sum`` is the psum.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from automodel_tpu.distributed.shardings import (
+    ParallelPlan,
+    sharding_context,
+    state_partition_specs,
+    to_named_shardings,
+)
+from automodel_tpu.loss.masked_ce import IGNORE_INDEX, MaskedCrossEntropy
+
+# Keys the model forward consumes; anything else in a batch is ignored.
+_MODEL_KEYS = ("input_ids", "position_ids", "segment_ids", "attention_mask")
+
+
+def _microbatch_loss(model, loss_fn, params, mb: Dict[str, jnp.ndarray]):
+    """Sum-CE of one microbatch. Routes the fused-linear-CE path when the
+    loss wants hidden states (reference ``calculate_loss`` routing,
+    ``train_ft.py:425-474``)."""
+    kwargs = {k: mb[k] for k in _MODEL_KEYS[1:] if mb.get(k) is not None}
+    labels = mb["labels"]
+    if getattr(loss_fn, "needs_hidden", False):
+        out = model(params, mb["input_ids"], return_hidden=True, **kwargs)
+        return loss_fn(out["hidden_states"], out["lm_head_kernel"], labels)
+    out = model(params, mb["input_ids"], **kwargs)
+    return loss_fn(out["logits"], labels)
+
+
+@dataclasses.dataclass
+class TrainStepFns:
+    """Compiled step functions + the state shardings they were built with."""
+
+    train_step: Callable
+    eval_step: Callable
+    init_opt_state: Callable
+    opt_state_sharding: Any
+    microbatch_sharding: Any
+
+
+def build_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    loss_fn: Optional[Any] = None,
+    plan: Optional[ParallelPlan] = None,
+    grad_dtype: Any = jnp.float32,
+) -> TrainStepFns:
+    """Build jitted ``train_step(params, opt_state, batch) ->
+    (params, opt_state, metrics)`` and ``eval_step(params, batch) -> metrics``.
+
+    ``batch`` arrays are shaped ``[A, B, S]`` with ``A`` = grad-accumulation
+    steps (``A=1`` for no accumulation); the scan over ``A`` replaces the
+    reference's microbatch loop + sync ctx (``train_ft.py:653-684``).
+    """
+    loss_fn = loss_fn if loss_fn is not None else MaskedCrossEntropy()
+    if getattr(loss_fn, "reduction", "sum") != "sum":
+        raise ValueError(
+            "build_train_step normalizes by the global label-token count "
+            "itself; configure the loss with reduction='sum' (got "
+            f"{loss_fn.reduction!r}) or it would be normalized twice.")
+    # Activation sharding constraints (TP/SP plan) are read from this context
+    # at trace time; identity when no plan is given.
+    if plan is not None:
+        ctx = functools.partial(sharding_context, plan.mesh, plan.rules)
+    else:
+        ctx = contextlib.nullcontext
+
+    def count_label_tokens(labels):
+        return jnp.sum(labels != IGNORE_INDEX).astype(jnp.float32)
+
+    def train_step(params, opt_state, batch):
+        num_label_tokens = count_label_tokens(batch["labels"])
+        denom = jnp.maximum(num_label_tokens, 1.0)
+
+        grad_fn = jax.value_and_grad(
+            functools.partial(_microbatch_loss, model, loss_fn))
+
+        def micro(grads_acc, mb):
+            loss_sum, grads = grad_fn(params, mb)
+            grads_acc = jax.tree.map(
+                lambda a, g: a + g.astype(grad_dtype), grads_acc, grads)
+            return grads_acc, loss_sum
+
+        zero_grads = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, grad_dtype), params)
+        with ctx():
+            grads, loss_sums = jax.lax.scan(micro, zero_grads, batch)
+        # Per-token normalization across the *global* step (dp_cp psum
+        # equivalent of reference base_recipe.py:354 + train_ft.py:676-681).
+        grads = jax.tree.map(lambda g: g / denom, grads)
+        grad_norm = optax.global_norm(grads)
+
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = {
+            "loss": jnp.sum(loss_sums) / denom,
+            "grad_norm": grad_norm,
+            "num_label_tokens": num_label_tokens,
+        }
+        return params, opt_state, metrics
+
+    def eval_step(params, batch):
+        num_label_tokens = count_label_tokens(batch["labels"])
+
+        def micro(loss_acc, mb):
+            return loss_acc + _microbatch_loss(model, loss_fn, params, mb), None
+
+        with ctx():
+            total, _ = jax.lax.scan(micro, jnp.float32(0.0), batch)
+        return {
+            "loss": total / jnp.maximum(num_label_tokens, 1.0),
+            "num_label_tokens": num_label_tokens,
+        }
+
+    if plan is not None:
+        mesh = plan.mesh
+        abs_params = model.abstract_params()
+        abs_opt = jax.eval_shape(tx.init, abs_params)
+        opt_specs = state_partition_specs(abs_opt, abs_params, plan.param_specs)
+        opt_sharding = to_named_shardings(mesh, opt_specs)
+        # [A, B, S]: grad-acc axis unsharded, batch over dp, seq over cp.
+        mb_sharding = NamedSharding(
+            mesh, P(None, *plan.batch_sharding.spec))
+        rep = NamedSharding(mesh, P())
+
+        train_jit = jax.jit(
+            train_step,
+            in_shardings=(plan.param_sharding, opt_sharding, mb_sharding),
+            out_shardings=(plan.param_sharding, opt_sharding, rep),
+            donate_argnums=(0, 1),
+        )
+        eval_jit = jax.jit(
+            eval_step,
+            in_shardings=(plan.param_sharding, mb_sharding),
+            out_shardings=rep,
+        )
+        init_opt = jax.jit(tx.init, out_shardings=opt_sharding)
+        return TrainStepFns(train_jit, eval_jit, init_opt,
+                            opt_sharding, mb_sharding)
+
+    return TrainStepFns(
+        jax.jit(train_step, donate_argnums=(0, 1)),
+        jax.jit(eval_step),
+        jax.jit(tx.init),
+        None, None,
+    )
+
+
+def stack_microbatches(microbatches) -> Dict[str, jnp.ndarray]:
+    """Stack a list of collated microbatch dicts into [A, B, S] arrays.
+
+    Every microbatch must carry the same keys — a key present in some but not
+    all microbatches is a collation bug (e.g. segment_ids emitted for only
+    part of a packed batch), so it raises instead of silently dropping.
+    """
+    import numpy as np
+
+    keys = set(microbatches[0])
+    for mb in microbatches[1:]:
+        if set(mb) != keys:
+            raise ValueError(
+                f"Inconsistent microbatch keys: {sorted(keys)} vs {sorted(mb)}")
+    return {
+        k: np.stack([np.asarray(mb[k]) for mb in microbatches], axis=0)
+        for k in sorted(keys)
+    }

@@ -1,0 +1,37 @@
+"""Token-accounting helpers for true tokens/sec reporting.
+
+Reference parity: ``nemo_automodel/components/training/utils.py:19-45``
+(``count_tail_padding`` via the flip+cumprod trick) and the per-step token
+counting at ``recipes/llm/train_ft.py:638-649``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+IGNORE_INDEX = -100
+
+
+def count_tail_padding(labels, ignore_label: int = IGNORE_INDEX) -> int:
+    """Number of *trailing* ignore-labeled tokens per row, summed.
+
+    Same flip+cumprod trick as the reference: a run of ignore labels at the
+    end of a row stays 1 under cumprod of the flipped mask; interior ignored
+    tokens (prompt masking) don't count.
+    """
+    labels = np.asarray(labels)
+    flipped = labels[..., ::-1] == ignore_label            # [B, S]
+    tail = np.cumprod(flipped, axis=-1)
+    return int(tail.sum())
+
+
+def count_tokens(batch, ignore_label: int = IGNORE_INDEX):
+    """(num_tokens_excluding_tail_padding, num_label_tokens) for a batch or
+    a list of microbatches."""
+    if isinstance(batch, (list, tuple)):
+        totals = [count_tokens(b, ignore_label) for b in batch]
+        return sum(t[0] for t in totals), sum(t[1] for t in totals)
+    labels = np.asarray(batch["labels"])
+    num_tokens = labels.size - count_tail_padding(labels, ignore_label)
+    num_label_tokens = int((labels != ignore_label).sum())
+    return num_tokens, num_label_tokens

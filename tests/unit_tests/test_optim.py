@@ -1,0 +1,100 @@
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from automodel_tpu.optim import (
+    OptimizerParamScheduler,
+    build_optimizer,
+    get_hyperparam,
+    set_hyperparams,
+)
+
+
+def make_sched(**kw):
+    defaults = dict(
+        init_lr=0.0, max_lr=1.0, min_lr=0.1,
+        lr_warmup_steps=10, lr_decay_steps=110, lr_decay_style="cosine",
+        start_wd=0.0, end_wd=0.1, wd_incr_steps=100, wd_incr_style="linear",
+    )
+    defaults.update(kw)
+    return OptimizerParamScheduler(**defaults)
+
+
+def test_warmup_linear():
+    s = make_sched()
+    s.num_steps = 5
+    assert s.get_lr() == pytest.approx(0.5)
+    s.num_steps = 10
+    assert s.get_lr() == pytest.approx(1.0)
+
+
+def test_cosine_decay_endpoints():
+    s = make_sched()
+    s.num_steps = 110
+    assert s.get_lr() == pytest.approx(0.1)
+    s.num_steps = 60  # halfway through decay
+    mid = 0.1 + 0.9 * 0.5 * (math.cos(math.pi * 0.5) + 1)
+    assert s.get_lr() == pytest.approx(mid)
+    s.num_steps = 200  # past decay -> min_lr
+    assert s.get_lr() == pytest.approx(0.1)
+
+
+def test_wsd_decay():
+    s = make_sched(lr_decay_style="WSD", wsd_decay_steps=10,
+                   lr_wsd_decay_style="linear")
+    s.num_steps = 50
+    assert s.get_lr() == pytest.approx(1.0)  # stable phase
+    s.num_steps = 105
+    assert s.get_lr() == pytest.approx(0.1 + 0.9 * 0.5)
+
+
+def test_wd_schedule():
+    s = make_sched()
+    s.num_steps = 50
+    assert s.get_wd() == pytest.approx(0.05)
+    s.num_steps = 150
+    assert s.get_wd() == pytest.approx(0.1)
+
+
+def test_state_roundtrip():
+    s = make_sched()
+    s.step(37)
+    sd = s.state_dict()
+    s2 = make_sched()
+    s2.load_state_dict(sd)
+    assert s2.num_steps == 37
+    assert s2.get_lr() == pytest.approx(s.get_lr())
+
+
+def test_build_optimizer_and_hyperparam_injection():
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    tx = build_optimizer(name="adamw", lr=0.1, weight_decay=0.01,
+                         betas=(0.9, 0.95), foreach=False)
+    state = tx.init(params)
+    assert float(get_hyperparam(state, "learning_rate")) == pytest.approx(0.1)
+
+    grads = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+    updates, state = tx.update(grads, state, params)
+    new_params = optax.apply_updates(params, updates)
+    assert not np.allclose(np.asarray(new_params["w"]), np.asarray(params["w"]))
+
+    state = set_hyperparams(state, lr=0.0, wd=0.0)
+    updates, state = tx.update(grads, state, new_params)
+    frozen = optax.apply_updates(new_params, updates)
+    np.testing.assert_allclose(
+        np.asarray(frozen["w"]), np.asarray(new_params["w"]), atol=1e-7)
+
+
+def test_masked_optimizer_freezes():
+    params = {"base": jnp.ones((2,)), "lora": jnp.ones((2,))}
+    tx = build_optimizer(name="adamw", lr=0.1,
+                         mask={"base": False, "lora": True})
+    state = tx.init(params)
+    grads = {"base": jnp.ones((2,)), "lora": jnp.ones((2,))}
+    updates, state = tx.update(grads, state, params)
+    out = optax.apply_updates(params, updates)
+    np.testing.assert_allclose(np.asarray(out["base"]), np.asarray(params["base"]))
+    assert not np.allclose(np.asarray(out["lora"]), np.asarray(params["lora"]))
