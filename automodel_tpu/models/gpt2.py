@@ -145,6 +145,7 @@ class GPT2LMHeadModel:
             params["wte"]["embedding"][input_ids]
             + params["wpe"]["embedding"][position_ids]
         ).astype(self.compute_dtype)
+        hidden = constrain(hidden, ("act_batch", "act_seq", "act_embed"))
 
         def body(h, p):
             return self._block(h, p, segment_ids, attention_mask), None
@@ -161,7 +162,8 @@ class GPT2LMHeadModel:
         )
         if return_hidden:
             return {"hidden_states": hidden, "lm_head_kernel": lm_kernel}
-        return {"logits": hidden @ lm_kernel.astype(self.compute_dtype)}
+        logits = hidden @ lm_kernel.astype(self.compute_dtype)
+        return {"logits": constrain(logits, ("act_batch", "act_seq_nosp", "act_vocab"))}
 
 
 def build_gpt2_model(**kwargs) -> GPT2LMHeadModel:

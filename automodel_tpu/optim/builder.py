@@ -29,6 +29,7 @@ def build_optimizer(
     momentum: float = 0.9,
     grad_clip_norm: Optional[float] = None,
     mask: Optional[Any] = None,
+    mu_dtype: Optional[Any] = None,
     **kwargs,
 ) -> optax.GradientTransformation:
     """Build an injectable-hyperparam optax optimizer.
@@ -43,6 +44,10 @@ def build_optimizer(
     for k in list(kwargs):
         if k in _IGNORED_TORCH_KWARGS:
             kwargs.pop(k)
+    if kwargs:
+        raise TypeError(
+            f"build_optimizer got unsupported kwargs {sorted(kwargs)}; "
+            f"torch-compat no-ops are {sorted(_IGNORED_TORCH_KWARGS)}")
     b1, b2 = float(betas[0]), float(betas[1])
     name = name.lower().replace("torch.optim.", "")
 
@@ -52,7 +57,8 @@ def build_optimizer(
         if grad_clip_norm:
             chain.append(optax.clip_by_global_norm(float(grad_clip_norm)))
         if name in ("adamw", "adam"):
-            chain.append(optax.scale_by_adam(b1=b1, b2=b2, eps=float(eps)))
+            chain.append(optax.scale_by_adam(
+                b1=b1, b2=b2, eps=float(eps), mu_dtype=mu_dtype))
             if name == "adamw":
                 chain.append(optax.add_decayed_weights(weight_decay))
         elif name == "sgd":

@@ -39,9 +39,12 @@ class StatefulRNG:
             k = jax.random.fold_in(k, int(s))
         return k
 
+    _NEXT_STREAM = 0x6E657874  # distinct first coord: next_key() never
+    # collides with key_for(step, ...) streams
+
     def next_key(self) -> jax.Array:
         self._fold_count += 1
-        return self.key_for(self._fold_count)
+        return self.key_for(self._NEXT_STREAM, self._fold_count)
 
     # -- context manager (save/restore host RNG states) --------------------
     def __enter__(self):

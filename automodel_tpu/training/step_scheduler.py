@@ -54,6 +54,8 @@ class StepScheduler:
     def epochs(self) -> Iterator[int]:
         start = self.epoch
         for e in range(start, self.num_epochs):
+            if self.finished:
+                return
             self.epoch = e
             yield e
 
@@ -62,6 +64,8 @@ class StepScheduler:
         of ``grad_acc_steps`` microbatches (last partial group is dropped,
         matching DistributedSampler drop-last semantics)."""
         assert self.dataloader is not None, "set_dataloader first"
+        if self.finished:
+            return
         self._epoch_exhausted = False
         group: List[Any] = []
         for batch in self.dataloader:

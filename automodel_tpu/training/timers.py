@@ -41,14 +41,14 @@ class _Timer:
         self._start = None
 
     def elapsed(self, reset: bool = True) -> float:
-        active = self._start is not None
-        if active:
-            self.stop()
+        # A running timer is read without stopping: the partial interval is
+        # included but NOT recorded in _history (mean() stays per-full-stop),
+        # and the timer keeps running from its original start.
         out = self._elapsed
+        if self._start is not None:
+            out += time.perf_counter() - self._start
         if reset:
             self._elapsed = 0.0
-        if active:
-            self.start()
         return out
 
     def mean(self) -> float:
@@ -60,8 +60,10 @@ class _Timer:
 
 
 def _device_barrier() -> None:
-    jax.block_until_ready(
-        jax.device_put(np.zeros(()), jax.devices()[0]))
+    # local_devices: jax.devices()[0] is unaddressable on processes > 0.
+    # device_get (not block_until_ready) so remote-tunnel runtimes truly sync.
+    jax.device_get(
+        jax.device_put(np.zeros(()), jax.local_devices()[0]))
 
 
 class Timers:

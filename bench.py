@@ -1,0 +1,96 @@
+"""Benchmark: Llama-1B-shape training step throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures the full jitted train step (fwd + fused-linear CE + bwd + AdamW) on
+a Llama-3.2-1B-shaped model, bf16 params, remat on — the BASELINE.md
+north-star config scaled to the single available chip.  ``vs_baseline`` is
+MFU / 0.40 (the ≥40% MFU v5e target).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+# v5e peak bf16 TFLOP/s per chip; override for other TPU generations.
+PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
+SMALL = bool(int(os.environ.get("BENCH_SMALL", "0")))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from automodel_tpu.loss.linear_ce import FusedLinearCrossEntropy
+    from automodel_tpu.loss.masked_ce import IGNORE_INDEX
+    from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from automodel_tpu.optim import build_optimizer
+    from automodel_tpu.training.train_step import build_train_step
+
+    if SMALL:
+        cfg = LlamaConfig(
+            vocab_size=2048, hidden_size=256, intermediate_size=1024,
+            num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4,
+            rope_theta=10000.0)
+        B, S, steps, warmup = 4, 512, 5, 2
+    else:
+        cfg = LlamaConfig(
+            vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+            num_hidden_layers=16, num_attention_heads=32,
+            num_key_value_heads=8, head_dim=64, rope_theta=500000.0,
+            rope_scaling={
+                "rope_type": "llama3", "factor": 32.0,
+                "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                "original_max_position_embeddings": 8192,
+            })
+        B, S, steps, warmup = int(os.environ.get("BENCH_BATCH", "4")), 2048, 10, 3
+
+    model = LlamaForCausalLM(cfg, param_dtype=jnp.bfloat16,
+                             compute_dtype=jnp.bfloat16, remat=True)
+    tx = build_optimizer(name="adamw", lr=1e-4, weight_decay=0.01,
+                         mu_dtype=jnp.bfloat16)
+    fns = build_train_step(
+        model, tx, loss_fn=FusedLinearCrossEntropy(chunk_len=1024),
+        grad_dtype=jnp.bfloat16)
+
+    params = model.init(jax.random.key(0))
+    opt_state = fns.init_opt_state(params)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size - 1, (1, B, S))
+    labels = np.roll(ids, -1, -1)
+    labels[..., -1] = IGNORE_INDEX
+    batch = {
+        "input_ids": jnp.asarray(ids, jnp.int32),
+        "labels": jnp.asarray(labels, jnp.int32),
+    }
+
+    for _ in range(warmup):
+        params, opt_state, m = fns.train_step(params, opt_state, batch)
+    # device_get, not block_until_ready: remote-tunnel runtimes may return
+    # from block_until_ready before execution finishes; a value fetch cannot.
+    float(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, m = fns.train_step(params, opt_state, batch)
+    final_loss = float(m["loss"])  # chained deps: syncs all timed steps
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
+
+    tokens_per_sec = B * S * steps / dt
+    mfu = tokens_per_sec * model.flops_per_token() / PEAK_FLOPS
+    print(json.dumps({
+        "metric": "llama1b_sft_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
