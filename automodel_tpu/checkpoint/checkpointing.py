@@ -1,0 +1,190 @@
+"""Checkpoint subsystem: Orbax sharded state + HF-safetensors model export.
+
+TPU re-design of the reference's DCP stack
+(``nemo_automodel/components/checkpoint/checkpointing.py:49-495`` plus the
+~3.3k LoC of vendored ``_backports``): Orbax plays DCP's role for sharded
+pytree state (model/optimizer), ``automodel_tpu.models.hf_io`` plays the
+``_HuggingFaceStorageWriter/Reader`` + consolidation role (the exported repo
+loads in HF ``transformers`` unchanged), and host-side stateful objects
+(schedulers, RNG, dataloaders) round-trip via ``state_dict()`` pickles.
+
+Checkpoint directory layout (reference ``base_recipe.py:126-180``):
+    <ckpt_dir>/epoch_{e}_step_{s}/
+        model/            consolidated HF safetensors or Orbax tree
+        optim/            Orbax optimizer + LR-scheduler state
+        <key>.pt          pickled state_dict of each tracked stateful
+        config.yaml       the run config
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import pickle
+import re
+from typing import Any, Optional
+
+import jax
+
+
+class CheckpointFormat(str, enum.Enum):
+    SAFETENSORS = "safetensors"
+    ORBAX = "orbax"
+
+
+@dataclasses.dataclass
+class CheckpointingConfig:
+    """Reference parity: ``checkpoint/checkpointing.py:49-70``."""
+
+    enabled: bool = True
+    checkpoint_dir: str = "checkpoints/"
+    model_save_format: str = "safetensors"
+    save_consolidated: bool = True
+    is_peft: bool = False
+    model_cache_dir: Optional[str] = None
+    model_repo_id: Optional[str] = None
+
+    def __post_init__(self):
+        if isinstance(self.model_save_format, CheckpointFormat):
+            self.model_save_format = self.model_save_format.value
+        assert self.model_save_format in ("safetensors", "orbax", "torch_save"), (
+            f"unknown model_save_format {self.model_save_format!r}")
+        if self.model_save_format == "torch_save":  # reference alias
+            self.model_save_format = "orbax"
+
+
+def build_checkpoint_config(cfg=None, **kwargs) -> CheckpointingConfig:
+    fields = {f.name for f in dataclasses.fields(CheckpointingConfig)}
+    if cfg is not None:
+        kwargs = {**{k: v for k, v in cfg.to_dict().items() if k in fields},
+                  **kwargs}
+    return CheckpointingConfig(**{k: v for k, v in kwargs.items() if k in fields})
+
+
+# ---------------------------------------------------------------------------
+# Orbax helpers
+# ---------------------------------------------------------------------------
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_pytree(path: str, tree: Any) -> None:
+    """Sharded pytree save — every process participates (Orbax collective)."""
+    ckptr = _checkpointer()
+    ckptr.save(os.path.abspath(path), tree, force=True)
+    ckptr.wait_until_finished()
+
+
+def restore_pytree(path: str, abstract: Any = None) -> Any:
+    """Restore with target structure/shardings from ``abstract`` (a pytree of
+    ``jax.ShapeDtypeStruct`` with ``.sharding`` set for sharded placement)."""
+    return _checkpointer().restore(os.path.abspath(path), abstract)
+
+
+def abstract_with_shardings(abstract: Any, shardings: Any) -> Any:
+    """Attach NamedShardings to an abstract pytree for placed restore."""
+    return jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        abstract, shardings)
+
+
+# ---------------------------------------------------------------------------
+# Model save / load (reference checkpointing.py:71-237)
+# ---------------------------------------------------------------------------
+def save_model(model, params: Any, weights_path: str,
+               config: Optional[CheckpointingConfig] = None,
+               peft_config: Any = None) -> None:
+    config = config or CheckpointingConfig()
+    os.makedirs(weights_path, exist_ok=True)
+    if config.is_peft or peft_config is not None:
+        from automodel_tpu.peft.lora import save_adapters
+
+        save_adapters(model, params, weights_path, peft_config)
+        return
+    if config.model_save_format == "safetensors":
+        from automodel_tpu.models.hf_io import save_hf_weights
+
+        save_hf_weights(model, params, weights_path)
+    else:
+        save_pytree(os.path.join(weights_path, "orbax"), params)
+
+
+def load_model(model, weights_path: str,
+               config: Optional[CheckpointingConfig] = None,
+               shardings: Any = None) -> Any:
+    """Parallel load into (sharded) device arrays — the meta-device-init
+    equivalent: abstract-eval first, stream only needed byte ranges."""
+    config = config or CheckpointingConfig()
+    if config.model_save_format == "safetensors":
+        from automodel_tpu.models.hf_io import load_hf_weights
+
+        return load_hf_weights(model, weights_path, shardings=shardings)
+    abstract = model.abstract_params()
+    if shardings is not None:
+        abstract = abstract_with_shardings(abstract, shardings)
+    return restore_pytree(os.path.join(weights_path, "orbax"), abstract)
+
+
+def save_optimizer(opt_state: Any, optim_path: str,
+                   scheduler: Any = None) -> None:
+    os.makedirs(optim_path, exist_ok=True)
+    save_pytree(os.path.join(optim_path, "state"), opt_state)
+    if scheduler is not None and jax.process_index() == 0:
+        save_stateful(optim_path, "lr_scheduler", scheduler)
+
+
+def load_optimizer(optim_path: str, abstract_state: Any,
+                   scheduler: Any = None) -> Any:
+    state = restore_pytree(os.path.join(optim_path, "state"), abstract_state)
+    if scheduler is not None:
+        load_stateful(optim_path, "lr_scheduler", scheduler)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# Host-side statefuls (schedulers, rng, dataloader) — rank-0 pickles
+# ---------------------------------------------------------------------------
+def save_stateful(dirpath: str, key: str, obj: Any) -> None:
+    sd = obj.state_dict() if hasattr(obj, "state_dict") else obj
+    with open(os.path.join(dirpath, f"{key}.pt"), "wb") as f:
+        pickle.dump(sd, f)
+
+
+def load_stateful(dirpath: str, key: str, obj: Any) -> Any:
+    path = os.path.join(dirpath, f"{key}.pt")
+    with open(path, "rb") as f:
+        sd = pickle.load(f)
+    if hasattr(obj, "load_state_dict"):
+        obj.load_state_dict(sd)
+        return obj
+    return sd
+
+
+def has_stateful(dirpath: str, key: str) -> bool:
+    return os.path.exists(os.path.join(dirpath, f"{key}.pt"))
+
+
+# ---------------------------------------------------------------------------
+# Latest-checkpoint discovery (reference base_recipe.py:182-221,363)
+# ---------------------------------------------------------------------------
+_CKPT_RE = re.compile(r"epoch_(\d+)_step_(\d+)$")
+
+
+def checkpoint_dir_name(epoch: int, step: int) -> str:
+    return f"epoch_{epoch}_step_{step}"
+
+
+def find_latest_checkpoint(checkpoint_dir: str) -> Optional[str]:
+    if not os.path.isdir(checkpoint_dir):
+        return None
+    best, best_key = None, (-1, -1)
+    for name in os.listdir(checkpoint_dir):
+        m = _CKPT_RE.search(name)
+        if m:
+            key = (int(m.group(1)), int(m.group(2)))
+            if key > best_key:
+                best_key, best = key, os.path.join(checkpoint_dir, name)
+    return best

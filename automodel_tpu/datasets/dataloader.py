@@ -1,0 +1,135 @@
+"""Stateful dataloader: deterministic shuffling + mid-epoch resume.
+
+Replaces the reference's ``torchdata StatefulDataLoader`` +
+``StatefulDistributedSampler`` pair (``recipes/llm/train_ft.py:243-307``).
+TPU-native shape: the loader yields the **global** microbatch as numpy
+arrays on every host (identical order everywhere — the sampler seed is
+shared); the train step's input sharding then slices each host's shards out
+of it (``jax.device_put`` with a NamedSharding is a no-copy slice per
+addressable shard).  This replaces per-rank sampler sharding: there is one
+logical batch stream, not one per rank.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from automodel_tpu.datasets.utils import default_collater
+
+
+class StatefulDataLoader:
+    """Map-style or iterable dataset -> collated global microbatches.
+
+    ``state_dict()``/``load_state_dict()`` resume mid-epoch: map-style resumes
+    by sample index into the epoch permutation; iterable resumes by skipping
+    consumed samples (the reference's StatefulDataLoader `.pt` behavior,
+    ``recipes/base_recipe.py:158-174``).
+    """
+
+    def __init__(
+        self,
+        dataset: Any,
+        batch_size: int,
+        collate_fn: Optional[Callable] = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        pad_seq_len_divisible: Optional[int] = None,
+        **_unused,
+    ) -> None:
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        if collate_fn is None:
+            collate_fn = default_collater
+        self.collate_fn = collate_fn
+        self.pad_seq_len_divisible = pad_seq_len_divisible
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self._index = 0          # samples consumed in the current epoch
+        self.is_map_style = hasattr(dataset, "__getitem__") and hasattr(
+            dataset, "__len__")
+
+    def set_epoch(self, epoch: int) -> None:
+        if epoch != self.epoch:
+            self.epoch = epoch
+            self._index = 0
+
+    def _collate(self, samples) -> Dict[str, np.ndarray]:
+        if self.pad_seq_len_divisible is not None:
+            return self.collate_fn(
+                samples, pad_seq_len_divisible=self.pad_seq_len_divisible)
+        return self.collate_fn(samples)
+
+    def _epoch_order(self) -> np.ndarray:
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            return rng.permutation(n)
+        return np.arange(n)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        if self.is_map_style:
+            order = self._epoch_order()
+            n = len(order)
+            i = self._index
+            while i + self.batch_size <= n or (
+                    not self.drop_last and i < n):
+                idxs = order[i:i + self.batch_size]
+                samples = [dict(self.dataset[int(j)]) for j in idxs]
+                i += len(idxs)
+                # Update state BEFORE yielding: a checkpoint taken after
+                # consuming this batch resumes at the next one, and an
+                # abandoned generator leaves consistent state (epoch rolls
+                # over as soon as its last batch is emitted).
+                more = i + self.batch_size <= n or (not self.drop_last and i < n)
+                if more:
+                    self._index = i
+                else:
+                    self._index = 0
+                    self.epoch += 1
+                yield self._collate(samples)
+                if not more:
+                    return
+        else:
+            it = iter(self.dataset)
+            skip = self._index
+            for _ in range(skip):
+                next(it, None)
+            batch = []
+            for sample in it:
+                batch.append(dict(sample))
+                if len(batch) == self.batch_size:
+                    self._index += self.batch_size
+                    yield self._collate(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                self._index += len(batch)
+                yield self._collate(batch)
+            self._index = 0
+            self.epoch += 1
+
+    def __len__(self) -> int:
+        if not self.is_map_style:
+            raise TypeError("iterable dataset loader has no len()")
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    # -- state round-trip --------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"epoch": self.epoch, "index": self._index,
+                "seed": self.seed, "shuffle": self.shuffle}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.epoch = sd["epoch"]
+        self._index = sd["index"]
+        self.seed = sd.get("seed", self.seed)
+        self.shuffle = sd.get("shuffle", self.shuffle)
+
+
+def build_dataloader(dataset, batch_size: int = 1, **kwargs) -> StatefulDataLoader:
+    """YAML-friendly builder (``dataloader._target_``)."""
+    return StatefulDataLoader(dataset, batch_size, **kwargs)

@@ -1,0 +1,154 @@
+"""Generic instruction dataset with YAML-declared column mapping.
+
+Reference parity: ``nemo_automodel/components/datasets/llm/
+column_mapped_text_instruction_dataset.py:249-404`` — map arbitrary dataset
+columns onto {context, question, answer} (or {question, answer}), load from
+an HF repo id or local json/jsonl files, map-style or streaming iterable,
+chat-template or plain tokenization, answer-only loss masking.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterator, List, Optional, Union
+
+from automodel_tpu.datasets.utils import CROSS_ENTROPY_IGNORE_IDX
+
+
+def make_iterable(val: Union[str, List[str]]) -> List[str]:
+    if isinstance(val, str):
+        return [val]
+    if isinstance(val, (list, tuple)):
+        return list(val)
+    raise ValueError(f"Expected str or list of str, got {type(val)}")
+
+
+def _str_is_hf_repo_id(val: str) -> bool:
+    return (
+        not os.path.exists(val)
+        and val.count("/") == 1
+        and not val.endswith((".json", ".jsonl"))
+    )
+
+
+def _load_local_json(paths: List[str]) -> List[dict]:
+    rows: List[dict] = []
+    for p in paths:
+        with open(p) as f:
+            if p.endswith(".jsonl"):
+                rows.extend(json.loads(line) for line in f if line.strip())
+            else:
+                data = json.load(f)
+                rows.extend(data if isinstance(data, list) else [data])
+    return rows
+
+
+def _has_chat_template(tokenizer) -> bool:
+    return getattr(tokenizer, "chat_template", None) is not None
+
+
+class ColumnMappedTextInstructionDataset:
+    """``column_mapping`` maps canonical keys to dataset columns, e.g.
+    ``{context: document, question: instruction, answer: response}``."""
+
+    def __init__(
+        self,
+        path_or_dataset_id: Union[str, List[str]],
+        column_mapping: Dict[str, str],
+        tokenizer,
+        split: Optional[str] = None,
+        answer_only_loss_mask: bool = True,
+        streaming: bool = False,
+        limit_dataset_samples: Optional[int] = None,
+        start_of_turn_token: Optional[str] = None,
+    ) -> None:
+        self.column_mapping = dict(column_mapping)
+        self.tokenizer = tokenizer
+        self.answer_only_loss_mask = answer_only_loss_mask
+        self.streaming = streaming
+        self.start_of_turn_token = start_of_turn_token
+        assert "answer" in self.column_mapping, "column_mapping must include 'answer'"
+        if answer_only_loss_mask and _has_chat_template(tokenizer):
+            assert start_of_turn_token is not None, (
+                "answer_only_loss_mask with a chat template requires "
+                "start_of_turn_token")
+
+        paths = make_iterable(path_or_dataset_id)
+        if all(isinstance(p, str) and _str_is_hf_repo_id(p) for p in paths):
+            from datasets import load_dataset
+
+            assert len(paths) == 1, "one HF repo id at a time"
+            if limit_dataset_samples is not None and split is not None:
+                split = f"{split}[:{limit_dataset_samples}]"
+            self.dataset = load_dataset(paths[0], split=split,
+                                        streaming=streaming)
+        else:
+            rows = _load_local_json(paths)
+            if limit_dataset_samples is not None:
+                rows = rows[:limit_dataset_samples]
+            self.dataset = rows
+
+    # -- mapping -----------------------------------------------------------
+    def _map_row(self, row: dict) -> Dict[str, str]:
+        return {dst: row[src] for dst, src in self.column_mapping.items()}
+
+    def _apply_tokenizer(self, sample: Dict[str, str]) -> Dict[str, List[int]]:
+        tok = self.tokenizer
+        context = sample.get("context", "")
+        question = sample.get("question", "")
+        answer = str(sample["answer"]).strip()
+        if _has_chat_template(tok):
+            user = " ".join(x for x in (context, question) if x)
+            ids = tok.apply_chat_template([
+                {"role": "user", "content": user},
+                {"role": "assistant", "content": answer},
+            ])
+            if self.answer_only_loss_mask:
+                start_id = tok(self.start_of_turn_token,
+                               add_special_tokens=False)["input_ids"][0]
+                first = ids.index(start_id)
+                response_start = ids.index(start_id, first + 1)
+            else:
+                response_start = 0
+            labels = list(ids)
+            labels[:response_start] = [CROSS_ENTROPY_IGNORE_IDX] * response_start
+            labels = labels[1:] + [CROSS_ENTROPY_IGNORE_IDX]
+            return {
+                "input_ids": list(ids),
+                "labels": labels,
+                "attention_mask": [1] * len(ids),
+            }
+        prompt = " ".join(x for x in (context, question) if x)
+        prompt_ids = tok(prompt)["input_ids"]
+        full_ids = tok(prompt + " " + answer)["input_ids"]
+        eos = getattr(tok, "eos_token_id", None)
+        if eos is not None and (not full_ids or full_ids[-1] != eos):
+            full_ids = full_ids + [eos]
+        labels = list(full_ids)
+        if self.answer_only_loss_mask:
+            n_ctx = len(prompt_ids)
+            labels[:n_ctx] = [CROSS_ENTROPY_IGNORE_IDX] * n_ctx
+        input_ids = full_ids[:-1]
+        labels = labels[1:]
+        return {
+            "input_ids": input_ids,
+            "labels": labels,
+            "attention_mask": [1] * len(input_ids),
+        }
+
+    # -- dataset protocol --------------------------------------------------
+    def __len__(self) -> int:
+        if self.streaming:
+            raise TypeError("streaming dataset has no len()")
+        return len(self.dataset)
+
+    def __getitem__(self, idx) -> Dict[str, List[int]]:
+        if self.streaming:
+            raise TypeError("streaming dataset is iterable-only")
+        row = self.dataset[idx]
+        return self._apply_tokenizer(self._map_row(row))
+
+    def __iter__(self) -> Iterator[Dict[str, List[int]]]:
+        for row in self.dataset:
+            yield self._apply_tokenizer(self._map_row(row))

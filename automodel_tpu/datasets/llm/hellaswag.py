@@ -1,0 +1,41 @@
+"""HellaSwag SFT wrapper (ctx → gold ending).
+
+Reference parity: ``nemo_automodel/components/datasets/llm/hellaswag.py:20``.
+"""
+
+from __future__ import annotations
+
+from automodel_tpu.datasets.utils import SFTSingleTurnPreprocessor
+
+
+class HellaSwag:
+    """Single-turn SFT over HellaSwag: context is the prompt, the gold ending
+    (by ``label`` index) is the target."""
+
+    def __init__(self, path_or_dataset, tokenizer, split: str = "train",
+                 num_samples_limit=None, trust_remote_code: bool = True):
+        from datasets import load_dataset
+
+        if isinstance(num_samples_limit, int):
+            split = f"{split}[:{num_samples_limit}]"
+        if isinstance(path_or_dataset, str):
+            raw = load_dataset(path_or_dataset, split=split)
+        else:
+            raw = path_or_dataset
+        processor = SFTSingleTurnPreprocessor(tokenizer)
+        self.dataset = processor.process(raw, self)
+
+    def get_context(self, examples):
+        return examples["ctx"]
+
+    def get_target(self, examples):
+        return [endings[int(lbl)]
+                for endings, lbl in zip(examples["endings"], examples["label"])]
+
+    def __getitem__(self, index):
+        ans = dict(self.dataset[index])
+        ans.pop("attention_mask", None)
+        return ans
+
+    def __len__(self):
+        return len(self.dataset)

@@ -1,0 +1,81 @@
+"""Synthetic Gaussian-length sentence datasets for tests.
+
+Reference parity: ``nemo_automodel/components/datasets/llm/mock.py:40`` /
+``mock_packed.py:56``.  Plain list-backed datasets (no HF hub access — the
+offline stand-in for hub data in unit tests).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List
+
+from automodel_tpu.datasets.llm.packed_sequence import PackedSequence
+
+
+def make_vocab(vocab_size: int = 100) -> Dict[str, int]:
+    vocab = {"<pad>": 0, "<eos>": 1}
+    for i in range(2, vocab_size):
+        vocab[f"tok_{i}"] = i
+    return vocab
+
+
+def gen_sentence_ids(vocab, mean_len: float, std_len: float, max_len: int) -> List[int]:
+    words = list(vocab.values())[2:]
+    L = max(1, min(max_len, int(random.gauss(mean_len, std_len))))
+    return random.choices(words, k=L) + [vocab["<eos>"]]
+
+
+def build_unpacked_dataset(
+    *,
+    num_sentences: int = 10,
+    mean_len: float = 20.0,
+    std_len: float = 6.0,
+    vocab_size: int = 100,
+    max_sentence_len: int = 64,
+    seed: int = 0,
+    tokenizer=None,
+) -> List[Dict[str, List[int]]]:
+    """Each example is one variable-length sentence with labels == input_ids
+    (self-supervised) and per-sentence position ids."""
+    random.seed(seed)
+    vocab = make_vocab(vocab_size)
+    eos_id = vocab["<eos>"]
+    examples = []
+    for _ in range(num_sentences):
+        sent = gen_sentence_ids(vocab, mean_len, std_len, max_sentence_len)
+        pos_ids, pos = [], 0
+        for tid in sent:
+            pos_ids.append(pos)
+            pos = 0 if tid == eos_id else pos + 1
+        examples.append({
+            "input_ids": sent,
+            "attention_mask": [1] * len(sent),
+            "labels": sent.copy(),
+            "position_ids": pos_ids,
+        })
+    return examples
+
+
+def build_packed_dataset(
+    *,
+    num_sentences: int = 10,
+    mean_len: float = 20.0,
+    std_len: float = 6.0,
+    vocab_size: int = 100,
+    max_sentence_len: int = 64,
+    packed_sequence_size: int = 64,
+    split_across_pack: bool = False,
+    seed: int = 0,
+    tokenizer=None,
+) -> PackedSequence:
+    """Pre-packed variant (reference ``mock_packed.py``) via the real packer."""
+    unpacked = [
+        {k: v for k, v in ex.items() if k in ("input_ids", "labels")}
+        for ex in build_unpacked_dataset(
+            num_sentences=num_sentences, mean_len=mean_len, std_len=std_len,
+            vocab_size=vocab_size, max_sentence_len=max_sentence_len, seed=seed)
+    ]
+    return PackedSequence(
+        unpacked, packed_sequence_size=packed_sequence_size,
+        split_across_pack=split_across_pack).pack()

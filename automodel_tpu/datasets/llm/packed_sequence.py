@@ -1,0 +1,161 @@
+"""Sequence packing with **segment ids** — the TPU-native encoding.
+
+Re-design of the reference's torchtune-derived packer
+(``nemo_automodel/components/datasets/llm/packed_sequence.py:29-334``): same
+greedy packing and ``split_across_pack`` semantics, but instead of the
+reference's 4-D block-diagonal causal masks
+(``create_block_causal_mask``/``packed_block_causal_mask``), each pack emits
+``segment_ids`` (1-based per sample; 0 = padding) — the encoding Pallas
+flash/splash attention and ``automodel_tpu.ops.attention`` consume directly,
+and which survives CP sequence sharding.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from automodel_tpu.datasets.utils import CROSS_ENTROPY_IGNORE_IDX
+
+logger = logging.getLogger(__name__)
+
+PACK_TYPE = Dict[str, List[int]]
+
+
+class PackedSequence:
+    """Greedy packer: concatenates samples up to ``packed_sequence_size``.
+
+    Each pack carries ``input_ids``, ``labels``, ``position_ids`` (restarting
+    per sample — RoPE sees each sample from position 0), ``segment_ids``, and
+    ``seq_lens``; ``loss_mask`` passes through when present.
+    """
+
+    def __init__(self, dataset, split: str = "train",
+                 packed_sequence_size: int = 2048,
+                 split_across_pack: bool = False,
+                 max_packs: Optional[int] = None,
+                 padding_idx: int = 0):
+        self.dataset = dataset
+        self.split = split
+        self.packed_sequence_size = packed_sequence_size
+        self.split_across_pack = split_across_pack
+        self.max_packs = max_packs
+        self.padding_idx = padding_idx
+        self.packs: List[PACK_TYPE] = []
+        self.packed_dataset: Optional[List[Dict[str, np.ndarray]]] = None
+
+    # -- packing -----------------------------------------------------------
+    def pack(self):
+        size = self.packed_sequence_size
+        cur = _empty_pack()
+        contains_loss_mask = "loss_mask" in _first(self.dataset)
+        if contains_loss_mask:
+            cur["loss_mask"] = []
+        next_seg = 1
+
+        for sample in self.dataset:
+            ids, labels = list(sample["input_ids"]), list(sample["labels"])
+            seq_len = len(ids)
+            if seq_len > size and not self.split_across_pack:
+                raise ValueError(
+                    f"Dataset sample is too long ({seq_len} > {size}). Set "
+                    "split_across_pack=True or increase packed_sequence_size.")
+            cur["input_ids"] += ids
+            cur["labels"] += labels
+            cur["position_ids"] += [p % size for p in range(seq_len)]
+            cur["segment_ids"] += [next_seg] * seq_len
+            cur["seq_lens"].append(seq_len)
+            if contains_loss_mask:
+                cur["loss_mask"] += list(sample["loss_mask"])
+            next_seg += 1
+
+            while len(cur["input_ids"]) > size and not self._stop():
+                cur, next_seg = self._split_and_add(cur, next_seg)
+            if self._stop():
+                break
+
+        if len(cur["input_ids"]) > 0 and not self._stop():
+            self._add(cur)
+
+        self.packed_dataset = [
+            {k: np.asarray(v, dtype=np.int32) for k, v in pack.items()}
+            for pack in self.packs
+        ]
+        logger.info("Total number of packs created: %d", len(self.packs))
+        return self
+
+    def _stop(self) -> bool:
+        return self.max_packs is not None and len(self.packs) >= self.max_packs
+
+    def _split_and_add(self, cur: PACK_TYPE, next_seg: int):
+        size = self.packed_sequence_size
+        if self.split_across_pack:
+            boundary = size
+            leftover = size - sum(cur["seq_lens"][:-1])
+            seq_lens = cur["seq_lens"][:-1] + ([leftover] if leftover > 0 else [])
+        else:
+            # last (partial) sample moves wholly to the next pack
+            boundary = len(cur["input_ids"]) - cur["seq_lens"][-1]
+            seq_lens = cur["seq_lens"][:-1]
+        pack = {k: cur[k][:boundary] for k in cur if k != "seq_lens"}
+        pack["seq_lens"] = seq_lens
+        self._add(pack)
+
+        rest = {k: cur[k][boundary:] for k in cur if k != "seq_lens"}
+        rest["seq_lens"] = [len(rest["input_ids"])] if rest["input_ids"] else []
+        if self.split_across_pack and rest["input_ids"]:
+            # continuation gets its own fresh segment id (consuming next_seg,
+            # so the next appended sample cannot collide with it) and
+            # restarted positions
+            rest["position_ids"] = [p % size for p in range(len(rest["input_ids"]))]
+            rest["segment_ids"] = [next_seg] * len(rest["input_ids"])
+            next_seg += 1
+        return rest, next_seg
+
+    def _add(self, pack: PACK_TYPE) -> None:
+        """Pad to packed_sequence_size and renumber segments densely from 1."""
+        size = self.packed_sequence_size
+        n = len(pack["input_ids"])
+        pad = size - n
+        out = dict(pack)
+        if pad > 0:
+            out["input_ids"] = pack["input_ids"] + [self.padding_idx] * pad
+            out["labels"] = pack["labels"] + [CROSS_ENTROPY_IGNORE_IDX] * pad
+            out["position_ids"] = pack["position_ids"] + [p % size for p in range(n, size)]
+            out["segment_ids"] = pack["segment_ids"] + [0] * pad   # 0 = padding
+            if "loss_mask" in pack:
+                out["loss_mask"] = pack["loss_mask"] + [0] * pad
+        remap: Dict[int, int] = {}
+        seg = []
+        for s in out["segment_ids"]:
+            if s == 0:
+                seg.append(0)
+            else:
+                remap.setdefault(s, len(remap) + 1)
+                seg.append(remap[s])
+        out["segment_ids"] = seg
+        self.packs.append(out)
+
+    # -- dataset protocol --------------------------------------------------
+    def __len__(self) -> int:
+        assert self.packed_dataset is not None, "call .pack() first"
+        return len(self.packed_dataset)
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        assert self.packed_dataset is not None, "call .pack() first"
+        item = dict(self.packed_dataset[idx])
+        item.pop("seq_lens", None)
+        return item
+
+
+def _empty_pack() -> PACK_TYPE:
+    return {"input_ids": [], "labels": [], "position_ids": [],
+            "segment_ids": [], "seq_lens": []}
+
+
+def _first(dataset):
+    for x in dataset:
+        return x
+    raise ValueError("empty dataset")

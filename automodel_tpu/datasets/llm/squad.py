@@ -1,0 +1,120 @@
+"""SQuAD SFT dataset: prompt/answer formatting with prompt-masked labels.
+
+Reference parity: ``nemo_automodel/components/datasets/llm/squad.py:37-182``
+(plain + chat-template paths, eos handling, optional fixed-length pad, the
+``___PAD_TOKEN_IDS___`` collation convention).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from automodel_tpu.datasets.utils import CROSS_ENTROPY_IGNORE_IDX, PAD_SENTINEL_KEY
+
+
+def _pad_to_seq_length(sample, pad_token_id, seq_length):
+    n = seq_length - len(sample)
+    return sample if n <= 0 else sample + [pad_token_id] * n
+
+
+def _add_pad_token(tokenizer):
+    pad_token_id = getattr(tokenizer, "pad_token_id", None)
+    if pad_token_id is None:
+        tokenizer.pad_token_id = tokenizer.eos_token_id
+        pad_token_id = tokenizer.pad_token_id
+    if getattr(tokenizer, "pad_token", None) is None and getattr(
+            tokenizer, "eos_token", None) is not None:
+        tokenizer.pad_token = tokenizer.eos_token
+    return pad_token_id
+
+
+def _package_tokenized_example(has_chat_template, input_ids, eos_token_id,
+                               pad_token_id, seq_length, context_len):
+    # llama3-style tokenizers don't append eos
+    if not has_chat_template and eos_token_id != input_ids[-1]:
+        input_ids = input_ids + [eos_token_id]
+
+    labels = input_ids.copy()
+    input_ids = input_ids[:-1]
+    attention_mask = [1] * len(input_ids)
+    labels[:context_len] = [CROSS_ENTROPY_IGNORE_IDX] * context_len
+    labels = labels[1:]
+    assert len(input_ids) == len(labels)
+
+    if isinstance(seq_length, int):
+        input_ids = _pad_to_seq_length(input_ids, pad_token_id, seq_length)
+        labels = _pad_to_seq_length(labels, CROSS_ENTROPY_IGNORE_IDX, seq_length)
+    attention_mask = attention_mask + [0] * (len(labels) - len(attention_mask))
+    return {
+        "input_ids": input_ids,
+        "labels": labels,
+        "attention_mask": attention_mask,
+        PAD_SENTINEL_KEY: {
+            "input_ids": pad_token_id,
+            "labels": CROSS_ENTROPY_IGNORE_IDX,
+            "attention_mask": 0,
+        },
+    }
+
+
+def _formatting_prompts_func(example, tokenizer, eos_token_id, pad_token_id,
+                             seq_length=None):
+    question = example["question"]
+    context = example["context"]
+    answer = example["answers"]["text"][0].strip() if example["answers"]["text"] else ""
+    prompt = f"Context: {context}\nQuestion: {question}\nAnswer:"
+    full_text = prompt + " " + answer
+    prompt_ids = tokenizer(prompt)["input_ids"]
+    input_ids = tokenizer(full_text)["input_ids"]
+    return _package_tokenized_example(
+        False, input_ids, eos_token_id, pad_token_id, seq_length, len(prompt_ids))
+
+
+def _formatting_prompts_func_with_chat_template(
+        example, tokenizer, eos_token_id, pad_token_id, seq_length=None,
+        start_of_turn_token=None):
+    messages = [
+        {"role": "user",
+         "content": f"{example['context']} {example['question']}"},
+        {"role": "assistant",
+         "content": example["answers"]["text"][0].strip()},
+    ]
+    input_ids = tokenizer.apply_chat_template(messages)
+    if isinstance(start_of_turn_token, str):
+        start_id = tokenizer(start_of_turn_token,
+                             add_special_tokens=False)["input_ids"][0]
+        first = input_ids.index(start_id)
+        response_start = input_ids.index(start_id, first + 1)
+    else:
+        response_start = 0
+    return _package_tokenized_example(
+        True, input_ids, eos_token_id, pad_token_id, seq_length, response_start)
+
+
+def make_squad_dataset(
+    tokenizer,
+    seq_length: Optional[int] = None,
+    limit_dataset_samples: Optional[int] = None,
+    start_of_turn_token: Optional[str] = None,
+    fp8: bool = False,
+    split: str = "train",
+    dataset_name: str = "squad",
+):
+    """Build the SQuAD SFT dataset (reference ``squad.py:111-182``)."""
+    from datasets import load_dataset
+
+    if isinstance(limit_dataset_samples, int):
+        split = f"{split}[:{limit_dataset_samples}]"
+    dataset = load_dataset(dataset_name, split=split)
+    eos_token_id = tokenizer.eos_token_id
+    pad_token_id = _add_pad_token(tokenizer)
+
+    if getattr(tokenizer, "chat_template", None) is not None:
+        fmt = lambda ex: _formatting_prompts_func_with_chat_template(
+            ex, tokenizer, eos_token_id, pad_token_id, seq_length,
+            start_of_turn_token)
+    else:
+        fmt = lambda ex: _formatting_prompts_func(
+            ex, tokenizer, eos_token_id, pad_token_id, seq_length)
+    return dataset.map(fmt, batched=False,
+                       remove_columns=dataset.column_names)

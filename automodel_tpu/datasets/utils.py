@@ -1,0 +1,190 @@
+"""Dataset/collation utilities.
+
+Reference parity: ``nemo_automodel/components/datasets/utils.py`` —
+``default_collater`` pads within the microbatch (with the
+``___PAD_TOKEN_IDS___`` convention and optional divisible-length padding),
+``SFTSingleTurnPreprocessor`` tokenizes context+target with prompt-masked
+labels.  Tensors are numpy (host side); the train step moves them to device.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+CROSS_ENTROPY_IGNORE_IDX = -100
+
+PAD_TOKEN_IDS = {
+    "labels": CROSS_ENTROPY_IGNORE_IDX,
+    "attention_mask": 0,
+    "loss_mask": 0,
+    "segment_ids": 0,      # segment 0 == padding for TPU attention kernels
+    "position_ids": 0,
+}
+
+PAD_SENTINEL_KEY = "___PAD_TOKEN_IDS___"
+
+
+def batchify(arr: np.ndarray) -> np.ndarray:
+    if arr.ndim == 1:
+        return arr[None, :]
+    return arr
+
+
+def extract_key_from_dicts(batch: List[dict], key: str) -> List:
+    return [x[key] for x in batch]
+
+
+def pad_within_micro(batch: List[List[int]], pad_token_id: Optional[int],
+                     pad_seq_len_divisible: Optional[int] = None) -> List[List[int]]:
+    """Pad each sequence to the longest in the microbatch (optionally rounded
+    up to a divisibility constraint — used for fp8/int8 and TPU lane
+    alignment)."""
+    max_len = max(map(len, batch))
+    if pad_seq_len_divisible:
+        max_len = (pad_seq_len_divisible - max_len % pad_seq_len_divisible) + max_len
+    if pad_token_id is None:
+        pad_token_id = batch[0][-1]
+    return [list(item) + [pad_token_id] * (max_len - len(item)) for item in batch]
+
+
+def find_last_non_pad_token(lst: List[int], value: int) -> Optional[int]:
+    i = len(lst) - 1
+    found = False
+    while i >= 0:
+        if lst[i] == value:
+            i -= 1
+            found = True
+        else:
+            return i if found else None
+    return None
+
+
+def get_pad_token_from_key(key: str,
+                           pad_token_ids: Optional[Dict[str, int]] = None) -> Optional[int]:
+    if pad_token_ids is not None and key in pad_token_ids:
+        return pad_token_ids[key]
+    return PAD_TOKEN_IDS.get(key, None)
+
+
+def make_attention_mask_from_labels(ids: List[int],
+                                    ignore_token: int = CROSS_ENTROPY_IGNORE_IDX) -> List[int]:
+    if len(ids) == 0:
+        return []
+    if ids[-1] != ignore_token:
+        return [1] * len(ids)
+    last = find_last_non_pad_token(ids, ignore_token)
+    if last is None:
+        return [1] * len(ids)
+    return [1] * (last + 1) + [0] * (len(ids) - last - 1)
+
+
+def default_collater(batch: List[dict],
+                     pad_seq_len_divisible: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """Pad-and-stack collater.  Returns int32 numpy arrays (int32 is the TPU-
+    native integer width; torch's LongTensor (int64) would double HBM traffic
+    for ids)."""
+    pad_token_ids = batch[0].pop(PAD_SENTINEL_KEY, None)
+    for item in batch[1:]:
+        item.pop(PAD_SENTINEL_KEY, None)
+    out = {}
+    for key in batch[0].keys():
+        padded = pad_within_micro(
+            extract_key_from_dicts(batch, key),
+            get_pad_token_from_key(key, pad_token_ids),
+            pad_seq_len_divisible,
+        )
+        out[key] = batchify(np.asarray(padded, dtype=np.int32))
+    return out
+
+
+class SFTSingleTurnPreprocessor:
+    """Generic single-turn text-to-text SFT preprocessor (reference
+    ``datasets/utils.py:150-267``): tokenize context+target, mask the prompt
+    with -100, pad every example to the dataset max length (rounded to 8)."""
+
+    def __init__(self, tokenizer):
+        self.tokenizer = tokenizer
+        self.block_size = None
+        self.preprocessing_num_workers = 1
+        self.overwrite_cache = False
+
+    def _tokenize_function(self, examples, dataset):
+        ctx = dataset.get_context(examples)
+        tgt = dataset.get_target(examples)
+        ctx_tok = self.tokenizer(ctx)
+        tgt_tok = self.tokenizer(tgt)
+
+        special = set(getattr(self.tokenizer, "all_special_ids", []) or [])
+        if len(ctx_tok["input_ids"][0]) > 0 and ctx_tok["input_ids"][0][-1] in special:
+            ctx_tok["input_ids"] = [ids[:-1] for ids in ctx_tok["input_ids"]]
+            ctx_tok["attention_mask"] = [m[:-1] for m in ctx_tok["attention_mask"]]
+        if len(tgt_tok["input_ids"][0]) > 0 and tgt_tok["input_ids"][0][0] in special:
+            tgt_tok["input_ids"] = [ids[1:] for ids in tgt_tok["input_ids"]]
+            tgt_tok["attention_mask"] = [m[1:] for m in tgt_tok["attention_mask"]]
+
+        out = {}
+        out["input_ids"] = [
+            c + t for c, t in zip(ctx_tok["input_ids"], tgt_tok["input_ids"])]
+        out["attention_mask"] = [
+            c + t for c, t in zip(ctx_tok["attention_mask"], tgt_tok["attention_mask"])]
+        # labels pre-shifted: -100 over the prompt (minus 1), target ids, -100 tail
+        out["labels"] = [
+            [CROSS_ENTROPY_IGNORE_IDX] * (len(c) - 1) + t + [CROSS_ENTROPY_IGNORE_IDX]
+            for c, t in zip(ctx_tok["input_ids"], tgt_tok["input_ids"])]
+        out["loss_mask"] = [
+            [1 if t != CROSS_ENTROPY_IGNORE_IDX else 0 for t in lbl]
+            for lbl in out["labels"]]
+        return out
+
+    def _compute_dataset_max_len(self, tokenized_ds) -> int:
+        max_len = max(len(x["input_ids"]) for x in tokenized_ds)
+        max_len = math.ceil(max_len / 8) * 8
+        if self.block_size is not None:
+            max_len = min(max_len, self.block_size)
+        return max_len
+
+    def _pad_function(self, max_len):
+        tk = self.tokenizer
+
+        def _pad(examples):
+            pad_id = getattr(tk, "pad_token_id", None) or 0
+            examples["input_ids"] = [
+                ids[:max_len] + [pad_id] * max(0, max_len - len(ids))
+                for ids in examples["input_ids"]]
+            examples["attention_mask"] = [
+                [1] * min(len(m), max_len) + [0] * max(0, max_len - len(m))
+                for m in examples["attention_mask"]]
+            examples["labels"] = [
+                lbl[:max_len] + [CROSS_ENTROPY_IGNORE_IDX] * max(0, max_len - len(lbl))
+                for lbl in examples["labels"]]
+            examples["loss_mask"] = [
+                lm[:max_len] + [0] * max(0, max_len - len(lm))
+                for lm in examples["loss_mask"]]
+            return examples
+
+        return _pad
+
+    def process(self, raw_dataset, ds):
+        if getattr(self.tokenizer, "pad_token", None) is None and getattr(
+                self.tokenizer, "bos_token", None) is not None:
+            self.tokenizer.pad_token = self.tokenizer.bos_token
+        tokenized = raw_dataset.map(
+            lambda x: self._tokenize_function(x, dataset=ds),
+            batched=True,
+            num_proc=self.preprocessing_num_workers,
+            remove_columns=raw_dataset.column_names,
+            load_from_cache_file=not self.overwrite_cache,
+            desc="Running tokenizer on dataset",
+        )
+        max_len = self._compute_dataset_max_len(tokenized)
+        tokenized = tokenized.map(
+            self._pad_function(max_len),
+            batched=True,
+            num_proc=self.preprocessing_num_workers,
+            load_from_cache_file=not self.overwrite_cache,
+            desc=f"Padding dataset to max length {max_len}",
+        )
+        return tokenized

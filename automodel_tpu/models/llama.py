@@ -66,6 +66,20 @@ class LlamaConfig:
         return cls(**kwargs)
 
 
+def llama3_2_1b_config() -> "LlamaConfig":
+    """The Llama-3.2-1B shape — the BASELINE.md north-star benchmark config,
+    shared by ``bench.py`` and ``__graft_entry__.py``."""
+    return LlamaConfig(
+        vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+        num_hidden_layers=16, num_attention_heads=32, num_key_value_heads=8,
+        head_dim=64, rope_theta=500000.0, tie_word_embeddings=True,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 32.0,
+            "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 8192,
+        })
+
+
 class LlamaForCausalLM:
     """Functional model: ``init`` builds the param pytree, ``__call__`` applies it."""
 

@@ -17,6 +17,9 @@ import optax
 
 _IGNORED_TORCH_KWARGS = {
     "foreach", "fused", "capturable", "maximize", "differentiable", "amsgrad",
+    # scheduler hints the reference YAML schema carries in the optimizer
+    # section (consumed by build_lr_scheduler, not the optimizer itself)
+    "min_lr", "max_lr",
 }
 
 

@@ -1,0 +1,106 @@
+"""Checkpoint-aware trainer base.
+
+Reference parity: ``nemo_automodel/recipes/base_recipe.py:90-363`` —
+``__setattr__`` auto-tracks any attribute exposing ``state_dict``/
+``load_state_dict`` (plus ConfigNode) into ``_state_tracked``, excluding
+names containing val/eval/test; ``save_checkpoint`` writes model weights,
+optimizer+scheduler, config.yaml, and pickles the rest on process 0;
+``load_checkpoint`` finds the latest ``epoch_*_step_*`` directory.
+
+The model itself is functional (structure + ``self.params`` pytree), so
+unlike the reference there is no nn.Module special-casing: ``save_checkpoint``
+saves ``self.params`` via the checkpoint subsystem and every tracked host
+object via its ``state_dict``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+import jax
+
+from automodel_tpu.checkpoint import checkpointing as ckpt
+from automodel_tpu.config.loader import ConfigNode, dump_yaml_config
+
+logger = logging.getLogger(__name__)
+
+_SKIP_SUBSTRINGS = ("val", "eval", "test")
+
+
+def has_load_restore_state(obj: Any) -> bool:
+    return hasattr(obj, "state_dict") and hasattr(obj, "load_state_dict")
+
+
+class BaseRecipe:
+    def __init__(self):
+        object.__setattr__(self, "_state_tracked", {})
+
+    def __setattr__(self, key: str, value: Any) -> None:
+        if not key.startswith("_") and not any(
+                s in key.lower() for s in _SKIP_SUBSTRINGS):
+            if has_load_restore_state(value) or isinstance(value, ConfigNode):
+                self._state_tracked[key] = value
+        object.__setattr__(self, key, value)
+
+    # -- save --------------------------------------------------------------
+    def save_checkpoint(self, epoch: int, step: int) -> str:
+        cfg: ckpt.CheckpointingConfig = getattr(
+            self, "checkpoint_config", None) or ckpt.CheckpointingConfig()
+        if not cfg.enabled:
+            return ""
+        path = os.path.join(
+            cfg.checkpoint_dir, ckpt.checkpoint_dir_name(epoch, step))
+        is_main = jax.process_index() == 0
+        if is_main:
+            os.makedirs(path, exist_ok=True)
+
+        # model weights (collective)
+        if getattr(self, "params", None) is not None:
+            ckpt.save_model(self.model, self.params,
+                            os.path.join(path, "model"), cfg,
+                            peft_config=getattr(self, "peft_config", None))
+        # optimizer + LR scheduler (collective)
+        if getattr(self, "opt_state", None) is not None:
+            ckpt.save_optimizer(self.opt_state, os.path.join(path, "optim"),
+                                scheduler=getattr(self, "lr_scheduler", None))
+        # host-side statefuls + config on process 0
+        if is_main:
+            for key, obj in self._state_tracked.items():
+                if key in ("lr_scheduler",):
+                    continue  # saved with the optimizer
+                if isinstance(obj, ConfigNode):
+                    dump_yaml_config(obj, os.path.join(path, "config.yaml"))
+                else:
+                    ckpt.save_stateful(path, key, obj)
+        logger.info("Saved checkpoint to %s", path)
+        return path
+
+    # -- load --------------------------------------------------------------
+    def load_checkpoint(self, restore_from: Optional[str] = None) -> Optional[str]:
+        cfg: ckpt.CheckpointingConfig = getattr(
+            self, "checkpoint_config", None) or ckpt.CheckpointingConfig()
+        path = restore_from or ckpt.find_latest_checkpoint(cfg.checkpoint_dir)
+        if path is None or not os.path.isdir(path):
+            return None
+
+        if getattr(self, "params", None) is not None:
+            self.params = ckpt.load_model(
+                self.model, os.path.join(path, "model"), cfg,
+                shardings=getattr(self, "param_sharding", None))
+        if getattr(self, "opt_state", None) is not None:
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                               sharding=getattr(x, "sharding", None)),
+                self.opt_state)
+            self.opt_state = ckpt.load_optimizer(
+                os.path.join(path, "optim"), abstract,
+                scheduler=getattr(self, "lr_scheduler", None))
+        for key, obj in self._state_tracked.items():
+            if key in ("lr_scheduler",) or isinstance(obj, ConfigNode):
+                continue
+            if ckpt.has_stateful(path, key):
+                ckpt.load_stateful(path, key, obj)
+        logger.info("Restored checkpoint from %s", path)
+        return path

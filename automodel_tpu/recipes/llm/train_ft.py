@@ -1,0 +1,395 @@
+"""The LLM SFT / PEFT / pretraining trainer.
+
+Reference parity: ``nemo_automodel/recipes/llm/train_ft.py:71-847``
+(``TrainFinetuneRecipeForNextTokenPrediction``) — same YAML schema
+(``step_scheduler``, ``model``, ``distributed``, ``loss_fn``, ``dataset``,
+``packed_sequence``, ``dataloader``, ``optimizer``, ``lr_scheduler``,
+``checkpoint``, ``rng``, ``peft``), same ``setup()`` +
+``run_train_validation_loop()`` surface.
+
+TPU-native hot loop: the reference's eager microbatch loop with no_sync /
+CP contexts / clip / optim / LR-step (``train_ft.py:630-731``) is one jitted
+train step (``automodel_tpu.training.train_step``); this file only stacks
+microbatches, feeds the device, steps the host-side schedules, and logs.
+"""
+
+from __future__ import annotations
+
+import inspect
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from automodel_tpu.checkpoint.checkpointing import build_checkpoint_config
+from automodel_tpu.config.arg_parser import parse_args_and_load_config
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.datasets.dataloader import StatefulDataLoader
+from automodel_tpu.datasets.llm.packed_sequence import PackedSequence
+from automodel_tpu.distributed.init import initialize_distributed
+from automodel_tpu.distributed.mesh import MeshManager
+from automodel_tpu.distributed.shardings import build_parallel_plan
+from automodel_tpu.loss.masked_ce import MaskedCrossEntropy
+from automodel_tpu.optim import (
+    OptimizerParamScheduler,
+    build_optimizer,
+    set_hyperparams,
+)
+from automodel_tpu.recipes.base_recipe import BaseRecipe
+from automodel_tpu.training.rng import StatefulRNG
+from automodel_tpu.training.step_scheduler import StepScheduler
+from automodel_tpu.training.timers import Timers
+from automodel_tpu.training.train_step import build_train_step, stack_microbatches
+from automodel_tpu.training.utils import count_tokens
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Stateless builders (reference train_ft.py:71-423)
+# ---------------------------------------------------------------------------
+def build_model(cfg_model: ConfigNode):
+    """Instantiate the model from YAML (``model._target_``)."""
+    return cfg_model.instantiate()
+
+
+def build_tokenizer(cfg: ConfigNode, model) -> Optional[Any]:
+    tok_cfg = cfg.get("tokenizer")
+    if isinstance(tok_cfg, ConfigNode) and "_target_" in tok_cfg:
+        return tok_cfg.instantiate()
+    # fall back to the model's checkpoint dir (AutoTokenizer, offline cache)
+    ckpt_dir = getattr(model, "checkpoint_dir", None)
+    if ckpt_dir is not None:
+        try:
+            from transformers import AutoTokenizer
+
+            return AutoTokenizer.from_pretrained(ckpt_dir)
+        except Exception:
+            logger.warning("No tokenizer found at %s", ckpt_dir)
+    return None
+
+
+def _accepts_kwarg(fn, name: str) -> bool:
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    if any(p.kind == inspect.Parameter.VAR_KEYWORD
+           for p in sig.parameters.values()):
+        return True
+    return name in sig.parameters
+
+
+def build_dataset(cfg_ds: ConfigNode, tokenizer=None):
+    target = cfg_ds.get("_target_")
+    if target is None:
+        raise ValueError("dataset config needs a _target_")
+    from automodel_tpu.config.loader import resolve_target
+
+    fn = resolve_target(target)
+    if tokenizer is not None and _accepts_kwarg(fn, "tokenizer"):
+        return cfg_ds.instantiate(tokenizer=tokenizer)
+    return cfg_ds.instantiate()
+
+
+def build_dataloader(cfg: ConfigNode, dataset, cfg_key: str = "dataloader",
+                     local_batch_size: int = 1, seed: int = 0):
+    """Dataset (+ optional packing) -> StatefulDataLoader.
+
+    Reference ``build_dataloader`` (``train_ft.py:226-307``): PackedSequence
+    wrapping when ``packed_sequence.packed_sequence_size > 0``, collate_fn
+    from YAML, batch sharding handled by the device placement (not a
+    per-rank sampler — see ``datasets/dataloader.py``)."""
+    packed_cfg = cfg.get("packed_sequence")
+    if packed_cfg is not None and int(packed_cfg.get("packed_sequence_size", 0) or 0) > 0:
+        dataset = PackedSequence(
+            dataset,
+            packed_sequence_size=int(packed_cfg.get("packed_sequence_size")),
+            split_across_pack=bool(packed_cfg.get("split_across_pack", False)),
+        ).pack()
+
+    dl_cfg = cfg.get(cfg_key)
+    kwargs: Dict[str, Any] = {}
+    if isinstance(dl_cfg, ConfigNode):
+        kwargs = {k: v for k, v in dl_cfg.to_dict().items()
+                  if k not in ("_target_",)}
+    kwargs.setdefault("batch_size", local_batch_size)
+    kwargs.setdefault("seed", seed)
+    target = kwargs and dl_cfg is not None and dl_cfg.get("_target_")
+    if target:
+        from automodel_tpu.config.loader import resolve_target
+
+        cls = resolve_target(target)
+        return cls(dataset, **kwargs)
+    return StatefulDataLoader(dataset, **kwargs)
+
+
+def build_step_scheduler(cfg_ss: Optional[ConfigNode], dp_size: int) -> StepScheduler:
+    kwargs: Dict[str, Any] = dict(dp_size=dp_size)
+    if cfg_ss is not None:
+        kwargs.update(cfg_ss.to_dict())
+    return StepScheduler(**kwargs)
+
+
+def build_lr_scheduler(cfg_lr: Optional[ConfigNode],
+                       opt_cfg: Optional[ConfigNode],
+                       total_steps: int) -> OptimizerParamScheduler:
+    lr = float(opt_cfg.get("lr", 1e-4)) if opt_cfg is not None else 1e-4
+    wd = float(opt_cfg.get("weight_decay", 0.0) or 0.0) if opt_cfg is not None else 0.0
+    defaults = dict(
+        init_lr=0.0, max_lr=lr,
+        min_lr=float(opt_cfg.get("min_lr", 0.0) or 0.0) if opt_cfg is not None else 0.0,
+        lr_warmup_steps=0, lr_decay_steps=max(total_steps, 1),
+        lr_decay_style="constant",
+        start_wd=wd, end_wd=wd, wd_incr_steps=0, wd_incr_style="constant",
+    )
+    if cfg_lr is not None:
+        overrides = {k: v for k, v in cfg_lr.to_dict().items()
+                     if k != "_target_"}
+        defaults.update(overrides)
+    return OptimizerParamScheduler(**defaults)
+
+
+def build_wandb(cfg: ConfigNode):
+    wandb_cfg = cfg.get("wandb")
+    if wandb_cfg is None or jax.process_index() != 0:
+        return None
+    try:
+        import wandb
+
+        return wandb.init(**{k: v for k, v in wandb_cfg.to_dict().items()})
+    except Exception as e:  # offline / not installed
+        logger.warning("wandb disabled: %s", e)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Recipe
+# ---------------------------------------------------------------------------
+class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
+    """``setup()`` then ``run_train_validation_loop()``."""
+
+    def __init__(self, cfg: ConfigNode):
+        super().__init__()
+        self.cfg = cfg
+
+    # -- setup -------------------------------------------------------------
+    def setup(self):
+        cfg = self.cfg
+        self.dist_info = initialize_distributed(
+            **(cfg.get("dist_env").to_dict()
+               if cfg.get("dist_env") is not None else {}))
+
+        # RNG
+        rng_cfg = cfg.get("rng")
+        self.rng = (rng_cfg.instantiate() if isinstance(rng_cfg, ConfigNode)
+                    and "_target_" in rng_cfg else StatefulRNG(
+                        seed=int(rng_cfg.get("seed", 42)) if rng_cfg else 42,
+                        ranked=bool(rng_cfg.get("ranked", False)) if rng_cfg else False))
+
+        # Mesh
+        dist_cfg = cfg.get("distributed")
+        if isinstance(dist_cfg, ConfigNode) and "_target_" in dist_cfg:
+            self.mesh_manager = dist_cfg.instantiate()
+        else:
+            kwargs = dist_cfg.to_dict() if dist_cfg is not None else {}
+            self.mesh_manager = MeshManager(**kwargs)
+
+        # Model + plan
+        self.model = build_model(cfg.get("model"))
+        self.plan = build_parallel_plan(self.model, self.mesh_manager)
+        self.param_sharding = self.plan.param_sharding
+
+        # Loss
+        loss_cfg = cfg.get("loss_fn")
+        self.loss_fn = (loss_cfg.instantiate()
+                        if isinstance(loss_cfg, ConfigNode) and "_target_" in loss_cfg
+                        else MaskedCrossEntropy())
+
+        # PEFT (optional)
+        self.peft_config = None
+        peft_cfg = cfg.get("peft")
+        mask = None
+        if isinstance(peft_cfg, ConfigNode):
+            from automodel_tpu.peft.lora import PeftConfig, build_lora
+
+            self.peft_config = (peft_cfg.instantiate()
+                                if "_target_" in peft_cfg
+                                else PeftConfig(**peft_cfg.to_dict()))
+            self.model, mask = build_lora(self.model, self.peft_config)
+            self.plan = build_parallel_plan(self.model, self.mesh_manager)
+            self.param_sharding = self.plan.param_sharding
+
+        # Optimizer
+        opt_cfg = cfg.get("optimizer")
+        opt_kwargs = {k: v for k, v in (opt_cfg.to_dict() if opt_cfg else {}).items()
+                      if k != "_target_"}
+        target = opt_cfg.get("_target_") if opt_cfg is not None else None
+        if isinstance(target, str) and not target.startswith("torch.optim"):
+            from automodel_tpu.config.loader import resolve_target
+
+            self.optimizer = resolve_target(target)(mask=mask, **opt_kwargs)
+        else:
+            if isinstance(target, str):
+                opt_kwargs.setdefault("name", target.rsplit(".", 1)[-1].lower())
+            self.optimizer = build_optimizer(mask=mask, **opt_kwargs)
+
+        # Jitted step
+        self.step_fns = build_train_step(
+            self.model, self.optimizer, loss_fn=self.loss_fn, plan=self.plan)
+
+        # Params: stream HF weights into shards, or fresh init
+        ckpt_dir = getattr(self.model, "checkpoint_dir", None)
+        if ckpt_dir is not None:
+            from automodel_tpu.models.hf_io import load_hf_weights
+
+            self.params = load_hf_weights(
+                self.model, ckpt_dir, shardings=self.param_sharding)
+        else:
+            with self.rng:
+                self.params = jax.jit(
+                    self.model.init,
+                    out_shardings=self.param_sharding)(self.rng.next_key())
+        if self.peft_config is not None:
+            from automodel_tpu.peft.lora import init_lora_params
+
+            self.params = init_lora_params(
+                self.model, self.params, self.peft_config, self.rng.next_key(),
+                self.param_sharding)
+        self.opt_state = self.step_fns.init_opt_state(self.params)
+
+        # Data
+        self.tokenizer = build_tokenizer(cfg, self.model)
+        ss_cfg = cfg.get("step_scheduler")
+        local_bs = int(ss_cfg.get("local_batch_size", 1)) if ss_cfg else 1
+        # The loader yields GLOBAL microbatches (see datasets/dataloader.py):
+        # reference local_batch_size is per-dp-rank, so the global microbatch
+        # is local_bs x dp_size.
+        global_mb = local_bs * self.mesh_manager.dp_size
+        dataset = build_dataset(cfg.get("dataset"), tokenizer=self.tokenizer)
+        self.dataloader = build_dataloader(
+            cfg, dataset, "dataloader",
+            local_batch_size=global_mb, seed=self.rng.seed)
+        self.val_dataloader = None
+        if cfg.get("validation_dataset") is not None:
+            val_ds = build_dataset(cfg.get("validation_dataset"),
+                                   tokenizer=self.tokenizer)
+            self.val_dataloader = build_dataloader(
+                cfg, val_ds, "validation_dataloader",
+                local_batch_size=global_mb, seed=self.rng.seed)
+
+        # Schedules
+        ss_kwargs = ss_cfg.to_dict() if ss_cfg is not None else {}
+        ss_kwargs.pop("local_batch_size", None)
+        self.step_scheduler = StepScheduler(
+            dp_size=self.mesh_manager.dp_size,
+            local_batch_size=local_bs,
+            dataloader=self.dataloader, **ss_kwargs)
+        total = ss_kwargs.get("max_steps") or 1000
+        self.lr_scheduler = build_lr_scheduler(
+            cfg.get("lr_scheduler"), cfg.get("optimizer"), total)
+
+        self.checkpoint_config = build_checkpoint_config(cfg.get("checkpoint"))
+        self.timers = Timers()
+        self.wandb = build_wandb(cfg)
+        # resume if a checkpoint exists
+        self.load_checkpoint()
+        return self
+
+    # -- hot loop ----------------------------------------------------------
+    def _device_batch(self, batches: List[Dict[str, np.ndarray]]):
+        stacked = stack_microbatches(batches)
+        stacked.pop("loss_mask", None)  # already folded into labels
+        sharding = self.step_fns.microbatch_sharding
+        if sharding is not None:
+            return jax.device_put(stacked, sharding)
+        return stacked
+
+    def _run_train_optim_step(self, batches: List[Dict[str, np.ndarray]]):
+        num_tokens, _ = count_tokens(batches)
+        self.lr_scheduler.step(1)
+        self.opt_state = set_hyperparams(
+            self.opt_state, lr=self.lr_scheduler.current_lr,
+            wd=self.lr_scheduler.current_wd)
+        batch = self._device_batch(batches)
+        t0 = time.perf_counter()
+        self.params, self.opt_state, metrics = self.step_fns.train_step(
+            self.params, self.opt_state, batch)
+        loss = float(metrics["loss"])     # device sync
+        dt = time.perf_counter() - t0
+        self.last_metrics = {
+            "loss": loss,
+            "grad_norm": float(metrics["grad_norm"]),
+            "lr": self.lr_scheduler.current_lr,
+            "num_label_tokens": int(metrics["num_label_tokens"]),
+            "tps": num_tokens / dt,
+            "step_time": dt,
+        }
+        return self.last_metrics
+
+    def _run_validation_epoch(self) -> Optional[float]:
+        if self.val_dataloader is None:
+            return None
+        total_loss, total_tokens = 0.0, 0
+        for vb in self.val_dataloader:
+            batch = self._device_batch([vb])
+            m = self.step_fns.eval_step(self.params, batch)
+            n = int(m["num_label_tokens"])
+            total_loss += float(m["loss"]) * max(n, 1)
+            total_tokens += n
+        return total_loss / max(total_tokens, 1)
+
+    def run_train_validation_loop(self):
+        sched = self.step_scheduler
+        is_main = self.dist_info.is_main
+        for epoch in sched.epochs:
+            if hasattr(self.dataloader, "set_epoch"):
+                self.dataloader.set_epoch(epoch)
+            for batches in sched:
+                metrics = self._run_train_optim_step(batches)
+                if is_main:
+                    logger.info(
+                        "step %d | loss %.4f | grad_norm %.3f | lr %.2e | "
+                        "tps %.0f | tokens %d",
+                        sched.step, metrics["loss"], metrics["grad_norm"],
+                        metrics["lr"], metrics["tps"],
+                        metrics["num_label_tokens"])
+                    if self.wandb is not None:
+                        self.wandb.log(metrics, step=sched.step)
+                if sched.is_val_step:
+                    val_loss = self._run_validation_epoch()
+                    if val_loss is not None and is_main:
+                        logger.info("step %d | val_loss %.4f",
+                                    sched.step, val_loss)
+                        if self.wandb is not None:
+                            self.wandb.log({"val_loss": val_loss},
+                                           step=sched.step)
+                if sched.is_ckpt_step and self.checkpoint_config.enabled:
+                    self.save_checkpoint(epoch, sched.step)
+                    self._last_ckpt_step = sched.step
+            # epoch-end / final checkpoint (reference is_ckpt_step's
+            # last-batch clause): the generator sets its exhausted flag only
+            # after the loop, so re-check here.
+            if (self.checkpoint_config.enabled and sched.is_ckpt_step
+                    and getattr(self, "_last_ckpt_step", -1) != sched.step):
+                self.save_checkpoint(epoch, sched.step)
+                self._last_ckpt_step = sched.step
+            if sched.finished:
+                break
+        return self
+
+
+def main(config_path: Optional[str] = None, argv=None):
+    """CLI entry (reference ``train_ft.py:833-847``)."""
+    logging.basicConfig(level=logging.INFO)
+    cfg = parse_args_and_load_config(argv, default_config=config_path)
+    recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg)
+    recipe.setup()
+    recipe.run_train_validation_loop()
+    return recipe
+
+
+if __name__ == "__main__":
+    main()

@@ -42,11 +42,15 @@ class _Timer:
 
     def elapsed(self, reset: bool = True) -> float:
         # A running timer is read without stopping: the partial interval is
-        # included but NOT recorded in _history (mean() stays per-full-stop),
-        # and the timer keeps running from its original start.
+        # included but NOT recorded in _history (mean() stays per-full-stop).
+        # On reset the running span is re-based to now so the partial
+        # interval is not reported twice.
         out = self._elapsed
+        now = time.perf_counter()
         if self._start is not None:
-            out += time.perf_counter() - self._start
+            out += now - self._start
+            if reset:
+                self._start = now
         if reset:
             self._elapsed = 0.0
         return out

@@ -178,15 +178,28 @@ def stack_microbatches(microbatches) -> Dict[str, jnp.ndarray]:
     Every microbatch must carry the same keys — a key present in some but not
     all microbatches is a collation bug (e.g. segment_ids emitted for only
     part of a packed batch), so it raises instead of silently dropping.
+    Microbatches collated to different sequence lengths are right-padded to
+    the longest using the per-key pad convention (labels -> -100 etc.).
     """
     import numpy as np
+
+    from automodel_tpu.datasets.utils import get_pad_token_from_key
 
     keys = set(microbatches[0])
     for mb in microbatches[1:]:
         if set(mb) != keys:
             raise ValueError(
                 f"Inconsistent microbatch keys: {sorted(keys)} vs {sorted(mb)}")
-    return {
-        k: np.stack([np.asarray(mb[k]) for mb in microbatches], axis=0)
-        for k in sorted(keys)
-    }
+    out = {}
+    for k in sorted(keys):
+        arrs = [np.asarray(mb[k]) for mb in microbatches]
+        max_s = max(a.shape[-1] for a in arrs)
+        if any(a.shape[-1] != max_s for a in arrs):
+            pad_val = get_pad_token_from_key(k) or 0
+            arrs = [
+                np.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, max_s - a.shape[-1])],
+                       constant_values=pad_val)
+                for a in arrs
+            ]
+        out[k] = np.stack(arrs, axis=0)
+    return out

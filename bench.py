@@ -27,7 +27,11 @@ def main() -> None:
 
     from automodel_tpu.loss.linear_ce import FusedLinearCrossEntropy
     from automodel_tpu.loss.masked_ce import IGNORE_INDEX
-    from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from automodel_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        llama3_2_1b_config,
+    )
     from automodel_tpu.optim import build_optimizer
     from automodel_tpu.training.train_step import build_train_step
 
@@ -38,15 +42,7 @@ def main() -> None:
             rope_theta=10000.0)
         B, S, steps, warmup = 4, 512, 5, 2
     else:
-        cfg = LlamaConfig(
-            vocab_size=128256, hidden_size=2048, intermediate_size=8192,
-            num_hidden_layers=16, num_attention_heads=32,
-            num_key_value_heads=8, head_dim=64, rope_theta=500000.0,
-            rope_scaling={
-                "rope_type": "llama3", "factor": 32.0,
-                "low_freq_factor": 1.0, "high_freq_factor": 4.0,
-                "original_max_position_embeddings": 8192,
-            })
+        cfg = llama3_2_1b_config()
         B, S, steps, warmup = int(os.environ.get("BENCH_BATCH", "4")), 2048, 10, 3
 
     model = LlamaForCausalLM(cfg, param_dtype=jnp.bfloat16,

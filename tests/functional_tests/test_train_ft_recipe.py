@@ -1,0 +1,71 @@
+"""End-to-end recipe test: YAML -> setup -> train -> checkpoint -> resume.
+
+The reference's functional-test role (``tests/functional_tests/
+hf_transformer_llm``) on the 8-device CPU mesh with the mock dataset.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from automodel_tpu.config.arg_parser import parse_args_and_load_config
+
+YAML = os.path.join(os.path.dirname(__file__), "..", "..",
+                    "examples", "llm_finetune", "tiny_llama_mock.yaml")
+
+
+def _make_recipe(tmp_path, extra=()):
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
+    )
+
+    argv = ["--config", YAML,
+            "--checkpoint.checkpoint_dir", str(tmp_path)] + list(extra)
+    cfg = parse_args_and_load_config(argv)
+    return TrainFinetuneRecipeForNextTokenPrediction(cfg)
+
+
+def test_recipe_trains_and_checkpoints(tmp_path):
+    recipe = _make_recipe(tmp_path).setup()
+    first = recipe._run_train_optim_step(next(iter(recipe.step_scheduler)))
+    recipe.run_train_validation_loop()
+    assert recipe.step_scheduler.step >= 12
+    # loss went down vs the very first step
+    assert recipe.last_metrics["loss"] < first["loss"]
+    # checkpoint dir was written with model + optim + statefuls
+    ckpts = [d for d in os.listdir(tmp_path) if d.startswith("epoch_")]
+    assert ckpts
+    latest = os.path.join(tmp_path, sorted(ckpts)[-1])
+    assert os.path.exists(os.path.join(latest, "model"))
+    assert os.path.exists(os.path.join(latest, "optim"))
+    assert os.path.exists(os.path.join(latest, "config.yaml"))
+    assert os.path.exists(os.path.join(latest, "step_scheduler.pt"))
+
+
+def test_recipe_resume_restores_state(tmp_path):
+    r1 = _make_recipe(tmp_path, ["--step_scheduler.max_steps", "4"]).setup()
+    r1.run_train_validation_loop()
+    params_after = r1.params
+
+    r2 = _make_recipe(tmp_path, ["--step_scheduler.max_steps", "4"]).setup()
+    # load_checkpoint ran inside setup: step scheduler resumed
+    assert r2.step_scheduler.step == 4
+    assert r2.lr_scheduler.num_steps == r1.lr_scheduler.num_steps
+    import jax
+
+    diffs = jax.tree.map(
+        lambda a, b: float(np.max(np.abs(
+            np.asarray(a, np.float32) - np.asarray(b, np.float32)))),
+        r2.params, params_after)
+    assert max(jax.tree.leaves(diffs)) == 0.0
+
+
+def test_recipe_multichip_mesh(tmp_path):
+    recipe = _make_recipe(
+        tmp_path,
+        ["--distributed.dp_size", "4", "--distributed.tp_size", "2",
+         "--step_scheduler.max_steps", "2",
+         "--checkpoint.enabled", "false"]).setup()
+    recipe.run_train_validation_loop()
+    assert recipe.step_scheduler.step == 2

@@ -1,0 +1,177 @@
+"""Data layer tests: collation, packing w/ segment ids, nanogpt bins, loader."""
+
+import numpy as np
+import pytest
+
+from automodel_tpu.datasets.dataloader import StatefulDataLoader
+from automodel_tpu.datasets.llm.mock import build_packed_dataset, build_unpacked_dataset
+from automodel_tpu.datasets.llm.nanogpt_dataset import (
+    NanogptDataset,
+    load_shard,
+    write_shard,
+)
+from automodel_tpu.datasets.llm.packed_sequence import PackedSequence
+from automodel_tpu.datasets.utils import (
+    CROSS_ENTROPY_IGNORE_IDX,
+    default_collater,
+    make_attention_mask_from_labels,
+    pad_within_micro,
+)
+
+
+def test_pad_within_micro_divisible():
+    out = pad_within_micro([[1, 2, 3], [4]], pad_token_id=0,
+                           pad_seq_len_divisible=8)
+    assert all(len(r) == 8 for r in out)
+    assert out[1] == [4, 0, 0, 0, 0, 0, 0, 0]
+
+
+def test_default_collater_pads_labels_with_ignore():
+    batch = [
+        {"input_ids": [1, 2, 3], "labels": [2, 3, -100]},
+        {"input_ids": [1], "labels": [5]},
+    ]
+    out = default_collater(batch)
+    assert out["input_ids"].shape == (2, 3)
+    assert out["labels"][1, 1] == CROSS_ENTROPY_IGNORE_IDX
+    assert out["input_ids"].dtype == np.int32
+
+
+def test_attention_mask_from_labels():
+    assert make_attention_mask_from_labels([1, 2, -100, -100]) == [1, 1, 0, 0]
+    assert make_attention_mask_from_labels([-100, 1, 2]) == [1, 1, 1]
+
+
+def test_packed_sequence_segment_ids():
+    data = [
+        {"input_ids": [1, 2, 3], "labels": [2, 3, -100]},
+        {"input_ids": [4, 5], "labels": [5, -100]},
+        {"input_ids": [6, 7, 8, 9], "labels": [7, 8, 9, -100]},
+    ]
+    ps = PackedSequence(data, packed_sequence_size=8).pack()
+    p0 = ps[0]
+    # first pack: samples 1+2 (3+2=5 tokens) + padding; sample 3 doesn't fit
+    np.testing.assert_array_equal(p0["segment_ids"][:5], [1, 1, 1, 2, 2])
+    assert (p0["segment_ids"][5:] == 0).all()
+    np.testing.assert_array_equal(p0["position_ids"][:5], [0, 1, 2, 0, 1])
+    assert (p0["labels"][5:] == CROSS_ENTROPY_IGNORE_IDX).all()
+    p1 = ps[1]
+    np.testing.assert_array_equal(p1["segment_ids"][:4], [1, 1, 1, 1])
+    assert len(ps) == 2
+
+
+def test_packed_sequence_split_across_pack():
+    data = [{"input_ids": list(range(10)), "labels": list(range(10))}]
+    ps = PackedSequence(data, packed_sequence_size=6,
+                        split_across_pack=True).pack()
+    assert len(ps) == 2
+    assert len(ps[0]["input_ids"]) == 6
+    # continuation lands in pack 2 with fresh positions
+    np.testing.assert_array_equal(ps[1]["position_ids"][:4], [0, 1, 2, 3])
+
+
+def test_packed_split_continuation_distinct_segment():
+    """A split continuation and the next sample must get different segment
+    ids — otherwise unrelated documents attend to each other."""
+    data = [{"input_ids": [i * 10 + j for j in range(6)],
+             "labels": [i * 10 + j for j in range(6)]} for i in range(3)]
+    ps = PackedSequence(data, packed_sequence_size=8,
+                        split_across_pack=True).pack()
+    p1 = ps[1]  # continuation of sample 2 + sample 3
+    segs = p1["segment_ids"]
+    ids = p1["input_ids"]
+    # tokens from different source samples never share a segment id
+    doc_of = {int(t): int(t) // 10 for t in ids if segs[list(ids).index(t)] != 0}
+    seg_to_docs = {}
+    for t, s in zip(ids, segs):
+        if s == 0:
+            continue
+        seg_to_docs.setdefault(int(s), set()).add(int(t) // 10)
+    for docs in seg_to_docs.values():
+        assert len(docs) == 1, seg_to_docs
+
+
+def test_packed_too_long_raises():
+    data = [{"input_ids": list(range(10)), "labels": list(range(10))}]
+    with pytest.raises(ValueError):
+        PackedSequence(data, packed_sequence_size=4).pack()
+
+
+def test_mock_packed_dataset():
+    ps = build_packed_dataset(num_sentences=20, packed_sequence_size=64, seed=1)
+    item = ps[0]
+    assert set(item) == {"input_ids", "labels", "position_ids", "segment_ids"}
+    assert item["input_ids"].shape == (64,)
+
+
+def test_nanogpt_roundtrip(tmp_path):
+    toks = np.arange(1000) % 7
+    write_shard(str(tmp_path / "shard0.bin"), toks)
+    back = load_shard(str(tmp_path / "shard0.bin"))
+    np.testing.assert_array_equal(np.asarray(back), toks.astype(np.uint16))
+
+    ds = NanogptDataset(str(tmp_path / "*.bin"), seq_len=64, rank=0, world_size=1)
+    items = list(ds)
+    assert len(items) == len(ds) == (1000 - 1) // 64
+    first = items[0]
+    np.testing.assert_array_equal(first["labels"][:-1], first["input_ids"][1:])
+
+
+def test_nanogpt_rank_split(tmp_path):
+    toks = np.arange(2000)  # unique tokens -> window prefixes are unique
+    write_shard(str(tmp_path / "s.bin"), toks)
+    a = list(NanogptDataset(str(tmp_path / "s.bin"), seq_len=64, rank=0, world_size=2))
+    b = list(NanogptDataset(str(tmp_path / "s.bin"), seq_len=64, rank=1, world_size=2))
+    total = (2000 - 1) // 64
+    assert len(a) + len(b) == total
+    # disjoint windows
+    a0 = {tuple(x["input_ids"][:4]) for x in a}
+    b0 = {tuple(x["input_ids"][:4]) for x in b}
+    assert not (a0 & b0)
+
+
+def test_nanogpt_bos_alignment(tmp_path):
+    toks = np.zeros(500, dtype=np.int64)
+    bos = 99
+    toks[::50] = bos
+    write_shard(str(tmp_path / "s.bin"), toks)
+    ds = NanogptDataset(str(tmp_path / "s.bin"), seq_len=64,
+                        align_to_bos=True, bos_token=bos, rank=0, world_size=1)
+    for item in ds:
+        assert item["input_ids"][0] == bos
+
+
+def test_dataloader_resume_mid_epoch():
+    data = build_unpacked_dataset(num_sentences=32, seed=3)
+    dl = StatefulDataLoader(data, batch_size=4, shuffle=True, seed=7)
+    it = iter(dl)
+    first_two = [next(it), next(it)]
+    sd = dl.state_dict()
+
+    dl2 = StatefulDataLoader(data, batch_size=4, shuffle=True, seed=7)
+    dl2.load_state_dict(sd)
+    resumed = next(iter(dl2))
+    # the resumed batch must equal batch #3 of a fresh run
+    dl3 = StatefulDataLoader(data, batch_size=4, shuffle=True, seed=7)
+    it3 = iter(dl3)
+    next(it3), next(it3)
+    expected = next(it3)
+    np.testing.assert_array_equal(resumed["input_ids"], expected["input_ids"])
+
+
+def test_dataloader_epoch_shuffles_differ():
+    data = build_unpacked_dataset(num_sentences=16, seed=3)
+    dl = StatefulDataLoader(data, batch_size=16, shuffle=True, seed=7,
+                            drop_last=False)
+    e0 = next(iter(dl))
+    e1 = next(iter(dl))
+    assert not np.array_equal(e0["input_ids"], e1["input_ids"])
+
+
+def test_dataloader_iterable(tmp_path):
+    toks = np.arange(1300) % 13
+    write_shard(str(tmp_path / "s.bin"), toks)
+    ds = NanogptDataset(str(tmp_path / "s.bin"), seq_len=32, rank=0, world_size=1)
+    dl = StatefulDataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(dl)
+    assert batches[0]["input_ids"].shape == (4, 32)
