@@ -1,0 +1,76 @@
+"""``automodel`` CLI: ``automodel <finetune|pretrain> <llm|vlm> -c cfg.yaml``.
+
+Reference parity: ``nemo_automodel/_cli/app.py:46-255`` — same verbs and
+dispatch.  TPU differences: no torchrun re-launch (one process per host; the
+TPU runtime owns all local chips, and multi-host bootstrap is
+``jax.distributed.initialize`` inside the recipe via ``dist_env``), and the
+SLURM path renders an sbatch script per host instead of a container srun.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import logging
+import sys
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+RECIPES = {
+    ("finetune", "llm"): "automodel_tpu.recipes.llm.train_ft",
+    ("pretrain", "llm"): "automodel_tpu.recipes.llm.train_ft",
+    ("finetune", "vlm"): "automodel_tpu.recipes.vlm.finetune",
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="automodel",
+        description="TPU-native day-0 fine-tuning/pre-training")
+    parser.add_argument("command", choices=["finetune", "pretrain"])
+    parser.add_argument("domain", choices=["llm", "vlm"])
+    parser.add_argument("--config", "-c", required=True)
+    parser.add_argument("--nproc-per-node", type=int, default=None,
+                        help="accepted for reference-CLI compat; ignored "
+                             "(the TPU runtime owns all local chips)")
+    return parser
+
+
+def load_function(module_path: str, fn_name: str = "main"):
+    module = importlib.import_module(module_path)
+    try:
+        return getattr(module, fn_name)
+    except AttributeError as e:
+        raise SystemExit(
+            f"Recipe {module_path} has no function {fn_name!r}") from e
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = build_parser()
+    args, overrides = parser.parse_known_args(argv)
+
+    key = (args.command, args.domain)
+    if key not in RECIPES:
+        raise SystemExit(f"No recipe for {args.command} {args.domain}")
+
+    # SLURM submission when the config carries a `slurm:` section
+    from automodel_tpu.config.loader import load_yaml_config
+
+    cfg = load_yaml_config(args.config)
+    if cfg.get("slurm") is not None:
+        from automodel_tpu.launcher.slurm.utils import submit_slurm_job
+
+        job_id = submit_slurm_job(cfg, args.command, args.domain, args.config)
+        logger.info("Submitted SLURM job %s", job_id)
+        return 0
+
+    recipe_main = load_function(RECIPES[key])
+    recipe_main(argv=["--config", args.config] + overrides)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

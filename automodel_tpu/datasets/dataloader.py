@@ -50,11 +50,16 @@ class StatefulDataLoader:
         self.drop_last = drop_last
         self.epoch = 0
         self._index = 0          # samples consumed in the current epoch
-        self.is_map_style = hasattr(dataset, "__getitem__") and hasattr(
-            dataset, "__len__")
+        self.is_map_style = (
+            hasattr(dataset, "__getitem__") and hasattr(dataset, "__len__")
+            and not getattr(dataset, "streaming", False))
 
     def set_epoch(self, epoch: int) -> None:
-        if epoch != self.epoch:
+        # Forward-only: the loader rolls itself to epoch+1 when it emits the
+        # last batch of an epoch, so a caller replaying the schedule's epoch
+        # number after resume must not rewind it (that would re-train the
+        # whole epoch with the identical permutation).
+        if epoch > self.epoch:
             self.epoch = epoch
             self._index = 0
 

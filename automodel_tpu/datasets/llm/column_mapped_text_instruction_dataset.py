@@ -79,10 +79,14 @@ class ColumnMappedTextInstructionDataset:
             from datasets import load_dataset
 
             assert len(paths) == 1, "one HF repo id at a time"
-            if limit_dataset_samples is not None and split is not None:
+            if (limit_dataset_samples is not None and split is not None
+                    and not streaming):
                 split = f"{split}[:{limit_dataset_samples}]"
             self.dataset = load_dataset(paths[0], split=split,
                                         streaming=streaming)
+            if streaming and limit_dataset_samples is not None:
+                # streaming rejects split-slice syntax; use take() instead
+                self.dataset = self.dataset.take(limit_dataset_samples)
         else:
             rows = _load_local_json(paths)
             if limit_dataset_samples is not None:

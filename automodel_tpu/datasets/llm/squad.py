@@ -73,11 +73,12 @@ def _formatting_prompts_func(example, tokenizer, eos_token_id, pad_token_id,
 def _formatting_prompts_func_with_chat_template(
         example, tokenizer, eos_token_id, pad_token_id, seq_length=None,
         start_of_turn_token=None):
+    answer = (example["answers"]["text"][0].strip()
+              if example["answers"]["text"] else "")
     messages = [
         {"role": "user",
          "content": f"{example['context']} {example['question']}"},
-        {"role": "assistant",
-         "content": example["answers"]["text"][0].strip()},
+        {"role": "assistant", "content": answer},
     ]
     input_ids = tokenizer.apply_chat_template(messages)
     if isinstance(start_of_turn_token, str):

@@ -63,6 +63,7 @@ def default_rules(sequence_parallel: bool = False) -> Rules:
         "norm": None,
         "head_dim": None,
         "pos": None,
+        "lora_rank": None,                    # LoRA rank dim: tiny, replicated
         "embed": FSDP_AXES,                   # FSDP: model dim sharded over (dp_shard, cp)
         "heads": (AXIS_TP,),                  # TP colwise (q/k/v out, o in)
         "qkv3": (AXIS_TP,),                   # gpt2 fused qkv out

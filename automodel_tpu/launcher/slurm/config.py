@@ -1,0 +1,39 @@
+"""SLURM launch configuration.
+
+Reference parity: ``nemo_automodel/components/launcher/slurm/config.py:20-41``
+(``SlurmConfig`` + ``VolumeMapping``), adapted for TPU pods: one task per
+host (JAX owns all local chips), ``jax.distributed`` coordinator env instead
+of MASTER_ADDR/torchrun.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class VolumeMapping:
+    source: str
+    dest: str
+
+    def to_str(self) -> str:
+        return f"{self.source}:{self.dest}"
+
+
+@dataclasses.dataclass
+class SlurmConfig:
+    job_name: str = "automodel"
+    account: str = ""
+    partition: str = ""
+    nodes: int = 1
+    ntasks_per_node: int = 1          # one JAX process per host
+    time: str = "01:00:00"
+    job_dir: str = "slurm_jobs"
+    chdir: Optional[str] = None
+    container_image: Optional[str] = None
+    extra_mounts: List[VolumeMapping] = dataclasses.field(default_factory=list)
+    env_vars: dict = dataclasses.field(default_factory=dict)
+    hf_home: Optional[str] = None
+    coordinator_port: int = 8476
+    command: Optional[str] = None

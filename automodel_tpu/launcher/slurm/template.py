@@ -1,0 +1,67 @@
+"""sbatch script template for TPU-pod SLURM clusters.
+
+Reference parity: ``nemo_automodel/components/launcher/slurm/template.py:42-87``
+— same header/env/command structure, with the torchrun/NCCL env replaced by
+``jax.distributed`` coordinator variables (one task per host; JAX picks up
+``COORDINATOR_ADDRESS``/process ids via ``initialize_distributed``).
+"""
+
+from __future__ import annotations
+
+import getpass
+import socket
+from datetime import datetime
+
+HEADER = (
+    "# -------------------------------------------------------------------\n"
+    "# automodel-tpu sbatch script\n"
+    "# User: {user}\n"
+    "# Host: {host}\n"
+    "# Date: {timestamp}\n"
+    "# -------------------------------------------------------------------\n"
+)
+
+TEMPLATE = (
+    """#!/bin/bash
+"""
+    + HEADER
+    + """\
+#SBATCH -A {account}
+#SBATCH -p {partition}
+#SBATCH -N {nodes}
+#SBATCH --ntasks-per-node {ntasks_per_node}
+#SBATCH --time {time}
+#SBATCH --mail-type=FAIL
+#SBATCH --exclusive
+#SBATCH --output={job_dir}/slurm_%x_%j.out
+#SBATCH -J {job_name}
+
+# Multi-host JAX env: first node is the distributed coordinator
+export COORDINATOR_ADDRESS=$(scontrol show hostnames $SLURM_JOB_NODELIST | head -n 1):{coordinator_port}
+export JAX_COORDINATOR_ADDRESS=$COORDINATOR_ADDRESS
+export JAX_NUM_PROCESSES=$SLURM_NNODES
+export JAX_PROCESS_ID=$SLURM_PROCID
+
+# Experiment env
+export HF_HOME={hf_home}
+{extra_env}
+
+read -r -d '' CMD <<'INNEREOF'
+cd {chdir}; whoami; date; pwd;
+{command}
+INNEREOF
+echo "$CMD"
+
+srun {container_flags} --export=ALL bash -c "$CMD"
+"""
+)
+
+
+def render_script(opts: dict, job_dir: str) -> str:
+    return TEMPLATE.format(
+        user=getpass.getuser(),
+        host=socket.gethostname(),
+        timestamp=datetime.now().strftime("%Y-%m-%d %H:%M:%S"),
+        job_dir=job_dir,
+        **opts,
+    )

@@ -1,0 +1,73 @@
+"""SLURM job submission.
+
+Reference parity: ``nemo_automodel/components/launcher/slurm/utils.py:65``
+(``submit_slurm_job``: render script, write to job dir, ``sbatch``, return
+job id).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+from typing import Optional
+
+from automodel_tpu.launcher.slurm.config import SlurmConfig
+from automodel_tpu.launcher.slurm.template import render_script
+
+
+def volume_map_to_str(mounts) -> str:
+    return ",".join(
+        m.to_str() if hasattr(m, "to_str") else str(m) for m in mounts)
+
+
+def render_slurm_script(slurm: SlurmConfig, command: str) -> str:
+    container_flags = ""
+    if slurm.container_image:
+        mounts = volume_map_to_str(slurm.extra_mounts)
+        container_flags = (
+            f"--container-image={slurm.container_image} "
+            + (f"--container-mounts={mounts} " if mounts else "")
+            + "--no-container-mount-home --container-entrypoint")
+    extra_env = "\n".join(
+        f"export {k}={v}" for k, v in (slurm.env_vars or {}).items())
+    return render_script(
+        {
+            "account": slurm.account,
+            "partition": slurm.partition,
+            "nodes": slurm.nodes,
+            "ntasks_per_node": slurm.ntasks_per_node,
+            "time": slurm.time,
+            "job_name": slurm.job_name,
+            "coordinator_port": slurm.coordinator_port,
+            "hf_home": slurm.hf_home or os.environ.get("HF_HOME", ""),
+            "extra_env": extra_env,
+            "chdir": slurm.chdir or os.getcwd(),
+            "command": command,
+            "container_flags": container_flags,
+        },
+        slurm.job_dir,
+    )
+
+
+def submit_slurm_job(cfg, command: str = "finetune", domain: str = "llm",
+                     config_path: Optional[str] = None) -> str:
+    """Write the sbatch script and submit it; returns the job id."""
+    slurm_cfg = cfg.get("slurm")
+    fields = {k: v for k, v in slurm_cfg.to_dict().items()}
+    run_cmd = fields.pop("command", None) or (
+        f"python -m automodel_tpu._cli.app {command} {domain} -c {config_path}")
+    slurm = SlurmConfig(**fields)
+    os.makedirs(slurm.job_dir, exist_ok=True)
+    script = render_slurm_script(slurm, run_cmd)
+    script_path = os.path.join(slurm.job_dir, f"{slurm.job_name}.sbatch")
+    with open(script_path, "w") as f:
+        f.write(script)
+    try:
+        out = subprocess.run(["sbatch", script_path], capture_output=True,
+                             text=True, check=True).stdout
+    except FileNotFoundError as e:
+        raise RuntimeError(
+            f"sbatch not found; script written to {script_path}") from e
+    m = re.search(r"Submitted batch job (\d+)", out)
+    return m.group(1) if m else out.strip()

@@ -1,0 +1,372 @@
+"""LoRA as a functional wrapper over param pytrees.
+
+TPU re-design of the reference's monkey-patched ``LinearLoRA(nn.Linear)`` +
+Triton kernels (``nemo_automodel/components/_peft/lora.py:35-419``,
+``lora_kernel.py``): instead of patching module classes, :class:`LoRAModel`
+wraps the functional base model; its params are ``{"base": <frozen base
+tree>, "lora": {<path>: {"A", "B"}}}``, and the forward *merges* each
+targeted kernel as ``W + (alpha/r) * A @ B`` before the base forward — XLA
+fuses the rank-r update into the surrounding program, so no custom kernel is
+needed for v1 (the reference's Triton fusion exists because eager PyTorch
+can't fuse).
+
+Base params are frozen through the optimizer mask (``optax.set_to_zero``,
+see ``automodel_tpu/optim/builder.py``), matching the reference's
+``requires_grad=False`` freeze at ``_peft/lora.py:322-363``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.peft.module_matcher import ModuleMatcher
+
+logger = logging.getLogger(__name__)
+
+PATH_SEP = "."
+
+
+@dataclasses.dataclass
+class PeftConfig:
+    """Reference parity: ``_peft/lora.py:35-66`` (``use_triton`` is accepted
+    and ignored — there is no Triton on TPU; the merge path is fused by XLA)."""
+
+    target_modules: List[str] = dataclasses.field(
+        default_factory=lambda: ["*_proj"])
+    exclude_modules: List[str] = dataclasses.field(default_factory=list)
+    match_all_linear: bool = False
+    dim: int = 8
+    alpha: int = 32
+    dropout: float = 0.0
+    dropout_position: str = "post"
+    lora_A_init: str = "xavier"
+    lora_dtype: Optional[str] = None
+    use_triton: bool = False
+
+    def __post_init__(self):
+        if self.dropout:
+            logger.warning(
+                "LoRA dropout is not supported in the merged-kernel path; "
+                "proceeding with dropout=0.0")
+            self.dropout = 0.0
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.dim
+
+
+def _iter_kernel_paths(axes_tree, prefix=()):
+    """Yield (path tuple, axes tuple) for every >=2-D kernel leaf."""
+    if isinstance(axes_tree, dict):
+        for k, v in axes_tree.items():
+            yield from _iter_kernel_paths(v, prefix + (k,))
+    else:
+        if prefix and prefix[-1] == "kernel" and len(axes_tree) >= 2:
+            yield prefix, axes_tree
+
+
+def match_targets(model, config: PeftConfig) -> Dict[str, Tuple[Tuple[str, ...], tuple]]:
+    """{dotted module path: (tree path of kernel, kernel logical axes)} for
+    every targeted linear (lm_head always skipped for causal LMs, reference
+    ``_peft/lora.py:344-350``)."""
+    matcher = ModuleMatcher(
+        target_modules=list(config.target_modules or []),
+        exclude_modules=list(config.exclude_modules or []),
+        match_all_linear=config.match_all_linear)
+    out = {}
+    for path, axes in _iter_kernel_paths(model.param_axes()):
+        module_path = PATH_SEP.join(path[:-1])
+        if path[:-1] and path[0] == "lm_head":
+            continue
+        if matcher.match(module_path):
+            out[module_path] = (path, axes)
+    return out
+
+
+class LoRAModel:
+    """Functional wrapper: delegates everything to the base model after
+    merging LoRA deltas into the targeted kernels."""
+
+    def __init__(self, base_model, peft_config: PeftConfig):
+        self.base_model = base_model
+        self.peft_config = peft_config
+        self.targets = match_targets(base_model, peft_config)
+        if not self.targets:
+            raise ValueError(
+                f"PEFT matched no modules for targets {peft_config.target_modules}")
+
+    # delegation ----------------------------------------------------------
+    @property
+    def config(self):
+        return self.base_model.config
+
+    @property
+    def checkpoint_dir(self):
+        return getattr(self.base_model, "checkpoint_dir", None)
+
+    @checkpoint_dir.setter
+    def checkpoint_dir(self, v):
+        self.base_model.checkpoint_dir = v
+
+    def flops_per_token(self):
+        return self.base_model.flops_per_token()
+
+    # params --------------------------------------------------------------
+    def _lora_shapes(self) -> Dict[str, Tuple[tuple, tuple]]:
+        abstract = self.base_model.abstract_params()
+        flat = _flatten(abstract)
+        r = self.peft_config.dim
+        shapes = {}
+        for mod_path, (tree_path, _axes) in self.targets.items():
+            kshape = flat[tree_path].shape
+            if len(kshape) == 3:      # stacked (L, in, out)
+                L, fin, fout = kshape
+                shapes[mod_path] = ((L, fin, r), (L, r, fout))
+            else:                     # (in, out)
+                fin, fout = kshape
+                shapes[mod_path] = ((fin, r), (r, fout))
+        return shapes
+
+    def init_lora(self, key: jax.Array) -> Dict[str, Any]:
+        cfg = self.peft_config
+        dtype = jnp.dtype(cfg.lora_dtype) if cfg.lora_dtype else (
+            self.base_model.param_dtype)
+        lora = {}
+        for i, (mod_path, (a_shape, b_shape)) in enumerate(
+                sorted(self._lora_shapes().items())):
+            k = jax.random.fold_in(key, i)
+            fin = a_shape[-2]
+            if cfg.lora_A_init == "gaussian":
+                A = jax.random.normal(k, a_shape, jnp.float32) / np.sqrt(cfg.dim)
+            else:  # xavier/kaiming-uniform over (in, r)
+                limit = np.sqrt(6.0 / fin)
+                A = jax.random.uniform(k, a_shape, jnp.float32, -limit, limit)
+            lora[mod_path] = {
+                "A": A.astype(dtype),
+                "B": jnp.zeros(b_shape, dtype),  # B=0: identity at init
+            }
+        return lora
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        kb, kl = jax.random.split(key)
+        return {"base": self.base_model.init(kb), "lora": self.init_lora(kl)}
+
+    def abstract_params(self):
+        return jax.eval_shape(self.init, jax.random.key(0))
+
+    def param_axes(self):
+        base_axes = self.base_model.param_axes()
+        flat_axes = _flatten(base_axes)
+        lora_axes = {}
+        for mod_path, (tree_path, axes) in self.targets.items():
+            if len(axes) == 3:
+                layers, a_in, a_out = axes
+                lora_axes[mod_path] = {
+                    "A": (layers, a_in, "lora_rank"),
+                    "B": (layers, "lora_rank", a_out),
+                }
+            else:
+                a_in, a_out = axes
+                lora_axes[mod_path] = {
+                    "A": (a_in, "lora_rank"),
+                    "B": ("lora_rank", a_out),
+                }
+        return {"base": base_axes, "lora": lora_axes}
+
+    def trainable_mask(self) -> Dict[str, Any]:
+        base_mask = jax.tree.map(lambda _: False,
+                                 self.base_model.abstract_params())
+        lora_mask = {
+            mod: {"A": True, "B": True} for mod in self.targets
+        }
+        return {"base": base_mask, "lora": lora_mask}
+
+    # forward -------------------------------------------------------------
+    def merge_params(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """base kernel + scale * A@B for each target (x@(W+sAB) == LoRA)."""
+        scale = self.peft_config.scale
+        merged_flat = _flatten(params["base"])
+        merged_flat = dict(merged_flat)
+        for mod_path, (tree_path, _axes) in self.targets.items():
+            ab = params["lora"][mod_path]
+            W = merged_flat[tree_path]
+            A = ab["A"].astype(jnp.float32)
+            B = ab["B"].astype(jnp.float32)
+            if W.ndim == 3:
+                delta = jnp.einsum("lir,lro->lio", A, B)
+            else:
+                delta = A @ B
+            merged_flat[tree_path] = (
+                W.astype(jnp.float32) + scale * delta).astype(W.dtype)
+        return _unflatten(merged_flat)
+
+    def __call__(self, params, *args, **kwargs):
+        return self.base_model(self.merge_params(params), *args, **kwargs)
+
+    @property
+    def num_trainable_params(self) -> int:
+        return sum(
+            int(np.prod(s)) for a, b in self._lora_shapes().values()
+            for s in (a, b))
+
+
+# ---------------------------------------------------------------------------
+# Recipe hooks
+# ---------------------------------------------------------------------------
+def build_lora(model, peft_config: PeftConfig):
+    """(wrapped model, optax trainable mask) — the recipe's
+    ``apply_lora_to_linear_modules`` equivalent (``_peft/lora.py:322``)."""
+    wrapped = LoRAModel(model, peft_config)
+    return wrapped, wrapped.trainable_mask()
+
+
+def init_lora_params(model: LoRAModel, base_params, peft_config: PeftConfig,
+                     key, shardings=None):
+    """Combine HF-loaded base params with freshly-initialized adapters."""
+    lora = model.init_lora(key)
+    if shardings is not None and isinstance(shardings, dict) and "lora" in shardings:
+        lora = jax.device_put(lora, shardings["lora"])
+    return {"base": base_params, "lora": lora}
+
+
+# ---------------------------------------------------------------------------
+# HF PEFT adapter export / import (reference checkpointing.py:409-427)
+# ---------------------------------------------------------------------------
+def _materialize_full(v) -> np.ndarray:
+    """Host copy of a possibly cross-host-sharded array.  Collective: every
+    process must call this (same pattern as hf_io.save_hf_weights)."""
+    if isinstance(v, jax.Array) and not v.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(v, tiled=True))
+    return np.asarray(jax.device_get(v))
+
+
+def _hf_adapter_entries(model: LoRAModel, params) -> Dict[str, np.ndarray]:
+    """Expand stacked adapters to HF-PEFT keys with torch (out, in) layout.
+
+    Collective on multi-host (adapter A matrices are FSDP-sharded)."""
+    from automodel_tpu.models.hf_io import _key_map_for
+
+    key_map = _key_map_for(model.base_model)
+    tensors: Dict[str, np.ndarray] = {}
+    for mod_path, (tree_path, _axes) in model.targets.items():
+        spec = key_map.get(tree_path)
+        if spec is None:
+            continue
+        base_key = spec.template[: -len(".weight")] if spec.template.endswith(
+            ".weight") else spec.template
+        ab = params["lora"][mod_path]
+        A = _materialize_full(ab["A"]).astype(np.float32)
+        B = _materialize_full(ab["B"]).astype(np.float32)
+        if A.ndim == 3:
+            for i in range(A.shape[0]):
+                k = base_key.format(i=i)
+                tensors[f"base_model.model.{k}.lora_A.weight"] = (
+                    np.ascontiguousarray(A[i].T))
+                tensors[f"base_model.model.{k}.lora_B.weight"] = (
+                    np.ascontiguousarray(B[i].T))
+        else:
+            tensors[f"base_model.model.{base_key}.lora_A.weight"] = (
+                np.ascontiguousarray(A.T))
+            tensors[f"base_model.model.{base_key}.lora_B.weight"] = (
+                np.ascontiguousarray(B.T))
+    return tensors
+
+
+def save_adapters(model: LoRAModel, params, out_dir: str,
+                  peft_config: Optional[PeftConfig] = None) -> None:
+    """Write HF-PEFT ``adapter_model.safetensors`` + ``adapter_config.json``.
+
+    All processes run the (collective) materialization; process 0 writes."""
+    tensors = _hf_adapter_entries(model, params)
+    if jax.process_index() != 0:
+        return
+    from safetensors.numpy import save_file
+
+    peft_config = peft_config or model.peft_config
+    os.makedirs(out_dir, exist_ok=True)
+    save_file(tensors, os.path.join(out_dir, "adapter_model.safetensors"))
+    adapter_cfg = {
+        "peft_type": "LORA",
+        "r": peft_config.dim,
+        "lora_alpha": peft_config.alpha,
+        "lora_dropout": peft_config.dropout,
+        "target_modules": sorted(
+            {m.rsplit(PATH_SEP, 1)[-1] for m in model.targets}),
+        "bias": "none",
+        "task_type": "CAUSAL_LM",
+        "base_model_name_or_path": getattr(model, "checkpoint_dir", None),
+    }
+    with open(os.path.join(out_dir, "adapter_config.json"), "w") as f:
+        json.dump(adapter_cfg, f, indent=2)
+
+
+def load_adapters(model: LoRAModel, params, adapter_dir: str, shardings=None):
+    """Restore adapters saved by :func:`save_adapters` into ``params``.
+
+    Shapes/dtypes are read from metadata only (never materializes the old
+    sharded arrays); pass ``shardings['lora']`` (or the full shardings tree)
+    to place the restored adapters on the mesh."""
+    from safetensors import safe_open
+
+    from automodel_tpu.models.hf_io import _key_map_for
+
+    key_map = _key_map_for(model.base_model)
+    path = os.path.join(adapter_dir, "adapter_model.safetensors")
+    new_lora = {}
+    with safe_open(path, framework="numpy") as f:
+        for mod_path, (tree_path, _axes) in model.targets.items():
+            spec = key_map[tree_path]
+            base_key = spec.template[: -len(".weight")]
+            old = params["lora"][mod_path]
+            if old["A"].ndim == 3:
+                A = np.stack([
+                    f.get_tensor(
+                        f"base_model.model.{base_key.format(i=i)}.lora_A.weight").T
+                    for i in range(old["A"].shape[0])])
+                B = np.stack([
+                    f.get_tensor(
+                        f"base_model.model.{base_key.format(i=i)}.lora_B.weight").T
+                    for i in range(old["B"].shape[0])])
+            else:
+                A = f.get_tensor(f"base_model.model.{base_key}.lora_A.weight").T
+                B = f.get_tensor(f"base_model.model.{base_key}.lora_B.weight").T
+            new_lora[mod_path] = {
+                "A": jnp.asarray(A, old["A"].dtype),
+                "B": jnp.asarray(B, old["B"].dtype),
+            }
+    if shardings is not None:
+        if isinstance(shardings, dict) and "lora" in shardings:
+            shardings = shardings["lora"]
+        new_lora = jax.device_put(new_lora, shardings)
+    return {"base": params["base"], "lora": new_lora}
+
+
+# ---------------------------------------------------------------------------
+def _flatten(tree, prefix=()):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, prefix + (k,)))
+    else:
+        out[prefix] = tree
+    return out
+
+
+def _unflatten(flat):
+    out: Dict[str, Any] = {}
+    for path, v in flat.items():
+        node = out
+        for part in path[:-1]:
+            node = node.setdefault(part, {})
+        node[path[-1]] = v
+    return out

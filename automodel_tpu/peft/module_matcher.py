@@ -1,0 +1,46 @@
+"""Module matching for PEFT target selection.
+
+Reference parity: ``nemo_automodel/components/_peft/module_matcher.py:22-111``
+— ``wildcard_match`` patterns; precedence: ``match_all_linear`` >
+``target_modules`` > all-linear-except-``exclude_modules``.  Here "modules"
+are pytree paths to 2-D+ ``kernel`` leaves (the functional analogue of
+nn.Linear), e.g. ``layers.self_attn.q_proj``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Optional
+
+
+def wildcard_match(pattern: str, name: Optional[str]) -> bool:
+    """``*`` matches any dotted-path run (reference ``module_matcher.py:41``)."""
+    if name is None:
+        return False
+    regex = "^" + re.escape(pattern).replace(r"\*", ".*") + "$"
+    return re.fullmatch(regex, name) is not None
+
+
+@dataclasses.dataclass
+class ModuleMatcher:
+    target_modules: List[str] = dataclasses.field(default_factory=list)
+    exclude_modules: List[str] = dataclasses.field(default_factory=list)
+    match_all_linear: bool = False
+
+    def match(self, name: str) -> bool:
+        """``name`` is the dotted pytree path of a linear kernel's parent
+        (e.g. ``layers.mlp.gate_proj``)."""
+        leaf = name.rsplit(".", 1)[-1]
+        if self.match_all_linear:
+            return not self._excluded(name, leaf)
+        if self.target_modules:
+            return any(
+                wildcard_match(p, name) or wildcard_match(p, leaf)
+                for p in self.target_modules)
+        return not self._excluded(name, leaf)
+
+    def _excluded(self, name: str, leaf: str) -> bool:
+        return any(
+            wildcard_match(p, name) or wildcard_match(p, leaf)
+            for p in self.exclude_modules)

@@ -86,9 +86,16 @@ class BaseRecipe:
             return None
 
         if getattr(self, "params", None) is not None:
-            self.params = ckpt.load_model(
-                self.model, os.path.join(path, "model"), cfg,
-                shardings=getattr(self, "param_sharding", None))
+            if getattr(self, "peft_config", None) is not None:
+                from automodel_tpu.peft.lora import load_adapters
+
+                self.params = load_adapters(
+                    self.model, self.params, os.path.join(path, "model"),
+                    shardings=getattr(self, "param_sharding", None))
+            else:
+                self.params = ckpt.load_model(
+                    self.model, os.path.join(path, "model"), cfg,
+                    shardings=getattr(self, "param_sharding", None))
         if getattr(self, "opt_state", None) is not None:
             abstract = jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,

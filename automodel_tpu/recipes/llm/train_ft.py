@@ -117,7 +117,7 @@ def build_dataloader(cfg: ConfigNode, dataset, cfg_key: str = "dataloader",
                   if k not in ("_target_",)}
     kwargs.setdefault("batch_size", local_batch_size)
     kwargs.setdefault("seed", seed)
-    target = kwargs and dl_cfg is not None and dl_cfg.get("_target_")
+    target = dl_cfg.get("_target_") if isinstance(dl_cfg, ConfigNode) else None
     if target:
         from automodel_tpu.config.loader import resolve_target
 
@@ -245,19 +245,23 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         if ckpt_dir is not None:
             from automodel_tpu.models.hf_io import load_hf_weights
 
-            self.params = load_hf_weights(
-                self.model, ckpt_dir, shardings=self.param_sharding)
+            if self.peft_config is not None:
+                base = load_hf_weights(
+                    self.model.base_model, ckpt_dir,
+                    shardings=self.param_sharding["base"])
+                from automodel_tpu.peft.lora import init_lora_params
+
+                self.params = init_lora_params(
+                    self.model, base, self.peft_config,
+                    self.rng.next_key(), self.param_sharding)
+            else:
+                self.params = load_hf_weights(
+                    self.model, ckpt_dir, shardings=self.param_sharding)
         else:
             with self.rng:
                 self.params = jax.jit(
                     self.model.init,
                     out_shardings=self.param_sharding)(self.rng.next_key())
-        if self.peft_config is not None:
-            from automodel_tpu.peft.lora import init_lora_params
-
-            self.params = init_lora_params(
-                self.model, self.params, self.peft_config, self.rng.next_key(),
-                self.param_sharding)
         self.opt_state = self.step_fns.init_opt_state(self.params)
 
         # Data
@@ -292,6 +296,8 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             cfg.get("lr_scheduler"), cfg.get("optimizer"), total)
 
         self.checkpoint_config = build_checkpoint_config(cfg.get("checkpoint"))
+        if self.peft_config is not None:
+            self.checkpoint_config.is_peft = True
         self.timers = Timers()
         self.wandb = build_wandb(cfg)
         # resume if a checkpoint exists

@@ -1,0 +1,13 @@
+"""20-line shim calling the recipe main (reference
+``examples/llm_finetune/finetune.py`` / ``examples/llm_pretrain/pretrain.py:20-33``)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+from automodel_tpu.recipes.llm.train_ft import main  # noqa: E402
+
+if __name__ == "__main__":
+    main()
