@@ -56,14 +56,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     if key not in RECIPES:
         raise SystemExit(f"No recipe for {args.command} {args.domain}")
 
-    # SLURM submission when the config carries a `slurm:` section
+    # SLURM submission when the config carries a `slurm:` section.  CLI
+    # overrides are applied first so `--slurm none` (which the generated job
+    # command appends to stop resubmission recursion) and any `--slurm.*`
+    # edits take effect before the check.
+    from automodel_tpu.config.arg_parser import parse_cli_overrides
     from automodel_tpu.config.loader import load_yaml_config
 
     cfg = load_yaml_config(args.config)
+    for dotted, value in parse_cli_overrides(overrides):
+        cfg.set_by_dotted(dotted, value)
     if cfg.get("slurm") is not None:
         from automodel_tpu.launcher.slurm.utils import submit_slurm_job
 
-        job_id = submit_slurm_job(cfg, args.command, args.domain, args.config)
+        job_id = submit_slurm_job(cfg, args.command, args.domain, args.config,
+                                  overrides=overrides)
         logger.info("Submitted SLURM job %s", job_id)
         return 0
 

@@ -212,6 +212,13 @@ def sharding_context(mesh: Mesh, rules: Optional[Rules] = None):
         _CTX.mesh, _CTX.rules = prev
 
 
+def current_sharding() -> Optional[Tuple[Mesh, Rules]]:
+    """(mesh, rules) of the active sharding context, or None."""
+    if _CTX.mesh is None or _CTX.mesh.empty:
+        return None
+    return _CTX.mesh, _CTX.rules
+
+
 def constrain(x: jax.Array, axes: Sequence[Optional[str]]) -> jax.Array:
     """``with_sharding_constraint`` by logical axis names; identity when no
     sharding context is active (single-device tests, abstract eval)."""

@@ -26,9 +26,7 @@ TEMPLATE = (
 """
     + HEADER
     + """\
-#SBATCH -A {account}
-#SBATCH -p {partition}
-#SBATCH -N {nodes}
+{account_line}{partition_line}#SBATCH -N {nodes}
 #SBATCH --ntasks-per-node {ntasks_per_node}
 #SBATCH --time {time}
 #SBATCH --mail-type=FAIL
@@ -36,15 +34,10 @@ TEMPLATE = (
 #SBATCH --output={job_dir}/slurm_%x_%j.out
 #SBATCH -J {job_name}
 
-# Multi-host JAX env: first node is the distributed coordinator
-export COORDINATOR_ADDRESS=$(scontrol show hostnames $SLURM_JOB_NODELIST | head -n 1):{coordinator_port}
-export JAX_COORDINATOR_ADDRESS=$COORDINATOR_ADDRESS
-export JAX_NUM_PROCESSES=$SLURM_NNODES
-export JAX_PROCESS_ID=$SLURM_PROCID
-
-# Experiment env
-export HF_HOME={hf_home}
-{extra_env}
+# jax.distributed.initialize autodetects the SLURM cluster (coordinator from
+# SLURM_JOB_NODELIST, process id from SLURM_PROCID inside each srun task) —
+# no torchrun/MASTER_ADDR equivalent is needed.
+{hf_home_line}{extra_env}
 
 read -r -d '' CMD <<'INNEREOF'
 cd {chdir}; whoami; date; pwd;
@@ -58,6 +51,13 @@ srun {container_flags} --export=ALL bash -c "$CMD"
 
 
 def render_script(opts: dict, job_dir: str) -> str:
+    opts = dict(opts)
+    account = opts.pop("account", "")
+    partition = opts.pop("partition", "")
+    hf_home = opts.pop("hf_home", "")
+    opts["account_line"] = f"#SBATCH -A {account}\n" if account else ""
+    opts["partition_line"] = f"#SBATCH -p {partition}\n" if partition else ""
+    opts["hf_home_line"] = f"export HF_HOME={hf_home}\n" if hf_home else ""
     return TEMPLATE.format(
         user=getpass.getuser(),
         host=socket.gethostname(),

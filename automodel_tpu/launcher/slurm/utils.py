@@ -39,7 +39,6 @@ def render_slurm_script(slurm: SlurmConfig, command: str) -> str:
             "ntasks_per_node": slurm.ntasks_per_node,
             "time": slurm.time,
             "job_name": slurm.job_name,
-            "coordinator_port": slurm.coordinator_port,
             "hf_home": slurm.hf_home or os.environ.get("HF_HOME", ""),
             "extra_env": extra_env,
             "chdir": slurm.chdir or os.getcwd(),
@@ -51,12 +50,17 @@ def render_slurm_script(slurm: SlurmConfig, command: str) -> str:
 
 
 def submit_slurm_job(cfg, command: str = "finetune", domain: str = "llm",
-                     config_path: Optional[str] = None) -> str:
+                     config_path: Optional[str] = None,
+                     overrides: Optional[list] = None) -> str:
     """Write the sbatch script and submit it; returns the job id."""
     slurm_cfg = cfg.get("slurm")
     fields = {k: v for k, v in slurm_cfg.to_dict().items()}
+    # `--slurm none` stops the in-job CLI from resubmitting itself; user
+    # overrides are forwarded so SLURM runs match local runs.
+    fwd = " ".join(str(o) for o in (overrides or []))
     run_cmd = fields.pop("command", None) or (
-        f"python -m automodel_tpu._cli.app {command} {domain} -c {config_path}")
+        f"python -m automodel_tpu._cli.app {command} {domain} "
+        f"-c {config_path} {fwd} --slurm none".strip())
     slurm = SlurmConfig(**fields)
     os.makedirs(slurm.job_dir, exist_ok=True)
     script = render_slurm_script(slurm, run_cmd)

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from automodel_tpu.distributed.shardings import constrain
-from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.attention import attention
 from automodel_tpu.ops.norms import layer_norm
 
 
@@ -123,7 +123,7 @@ class GPT2LMHeadModel:
         qkv = x @ p["attn"]["c_attn"]["kernel"].astype(cd) + p["attn"]["c_attn"]["bias"].astype(cd)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (B, S, nh, H // nh)
-        attn = dot_product_attention(
+        attn = attention(
             q.reshape(shape), k.reshape(shape), v.reshape(shape),
             causal=True, segment_ids=segment_ids, attention_mask=attention_mask,
         ).reshape(B, S, H)

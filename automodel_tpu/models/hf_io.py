@@ -316,24 +316,8 @@ def save_hf_config(model, out_dir: str) -> None:
         json.dump(d, f, indent=2, default=str)
 
 
-# ---------------------------------------------------------------------------
-# pytree flatten helpers (path-keyed dicts)
-# ---------------------------------------------------------------------------
-def _flatten(tree: Any, prefix: Tuple[str, ...] = ()) -> Dict[Tuple[str, ...], Any]:
-    out: Dict[Tuple[str, ...], Any] = {}
-    if isinstance(tree, dict):
-        for k, v in tree.items():
-            out.update(_flatten(v, prefix + (str(k),)))
-    else:
-        out[prefix] = tree
-    return out
-
-
-def _unflatten(flat: Dict[Tuple[str, ...], Any]) -> Dict[str, Any]:
-    out: Dict[str, Any] = {}
-    for path, v in flat.items():
-        node = out
-        for part in path[:-1]:
-            node = node.setdefault(part, {})
-        node[path[-1]] = v
-    return out
+# path-keyed pytree flatten helpers (shared)
+from automodel_tpu.utils.pytree import (  # noqa: E402
+    flatten_path_dict as _flatten,
+    unflatten_path_dict as _unflatten,
+)

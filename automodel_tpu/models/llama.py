@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from automodel_tpu.distributed.shardings import constrain
-from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.attention import attention
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.rotary import apply_rope, rope_frequencies
 
@@ -211,7 +211,7 @@ class LlamaForCausalLM:
             q = rms_norm(q, p["self_attn"]["q_norm"]["weight"], cfg.rms_norm_eps)
             k = rms_norm(k, p["self_attn"]["k_norm"]["weight"], cfg.rms_norm_eps)
         q, k = apply_rope(q, k, position_ids, inv_freq)
-        attn = dot_product_attention(
+        attn = attention(
             q, k, v,
             causal=True,
             segment_ids=segment_ids,

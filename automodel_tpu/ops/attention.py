@@ -94,3 +94,67 @@ def dot_product_attention(
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
     return out.reshape(B, Sq, Hq, D)
+
+
+def attention(
+    q: jnp.ndarray,  # [B, S, Hq, D]
+    k: jnp.ndarray,  # [B, S, Hk, D]
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jnp.ndarray] = None,
+    attention_mask: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    logits_soft_cap: Optional[float] = None,
+) -> jnp.ndarray:
+    """Backend dispatcher — the framework's attention entry point.
+
+    Reference analogue: the fa3->fa2->sdpa fallback chain
+    (``_transformers/auto_model.py:50-144``), TPU-ified:
+
+    * active sharding context with ``cp > 1``  -> **ring attention**
+      (``shard_map`` + ``ppermute`` over the cp axis; the reference's
+      ``context_parallel``, ``distributed/cp_utils.py:102-149``);
+    * TPU backend + block-aligned shapes       -> **Pallas flash attention**
+      (segment-id native);
+    * otherwise                                -> XLA SDPA (this module) —
+      always correct under GSPMD, used on CPU test meshes.
+    """
+    from automodel_tpu.distributed.shardings import current_sharding
+
+    ctx = current_sharding()
+    if ctx is not None:
+        mesh, _rules = ctx
+        if "cp" in mesh.shape and mesh.shape["cp"] > 1 and logits_soft_cap is None:
+            from automodel_tpu.ops.ring_attention import sharded_ring_attention
+
+            seg = segment_ids
+            if attention_mask is not None:
+                base = seg if seg is not None else jnp.ones(
+                    attention_mask.shape, jnp.int32)
+                seg = jnp.where(attention_mask.astype(bool), base, 0)
+            return sharded_ring_attention(
+                q, k, v, mesh, causal=causal, segment_ids=seg, scale=scale)
+
+    from automodel_tpu.ops.flash_attention import (
+        flash_attention_available,
+        flash_attention_bshd,
+        sharded_flash_attention,
+    )
+
+    if logits_soft_cap is None and flash_attention_available(
+            q.shape[1], k.shape[1], q.shape[3],
+            attention_mask is not None):
+        if ctx is not None:
+            # pallas_call must run per-shard under GSPMD
+            return sharded_flash_attention(
+                q, k, v, ctx[0], causal=causal, segment_ids=segment_ids,
+                attention_mask=attention_mask, scale=scale)
+        return flash_attention_bshd(
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            attention_mask=attention_mask, scale=scale)
+
+    return dot_product_attention(
+        q, k, v, causal=causal, segment_ids=segment_ids,
+        attention_mask=attention_mask, scale=scale,
+        logits_soft_cap=logits_soft_cap)

@@ -1,0 +1,152 @@
+"""TPU flash attention: Pallas kernel with segment-id packing support.
+
+This is the TPU equivalent of the reference's FlashAttention-2 path
+(``nemo_automodel/components/_transformers/auto_model.py:50-144``) and of
+FA2-for-packed-sequences with position_ids (``recipes/llm/train_ft.py:113-118``):
+the Pallas MHA kernel (``jax.experimental.pallas.ops.tpu.flash_attention``)
+consumes *segment ids* natively, so packed sequences need no 4-D masks.
+
+Dispatch contract (used by ``automodel_tpu.ops.attention``): the kernel path
+requires a TPU backend and block-aligned shapes; anything else falls back to
+the XLA SDPA — same fallback-chain idea as the reference's fa3->fa2->sdpa
+(``auto_model.py:119-144``), with XLA in the anchor role.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+_BLOCK = 128  # minimum pallas flash block (MIN_BLOCK_SIZE)
+
+
+def flash_attention_available(q_seq: int, kv_seq: int, head_dim: int,
+                              has_padding_mask: bool) -> bool:
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return False
+    return (
+        backend == "tpu"
+        and q_seq % _BLOCK == 0
+        and kv_seq % _BLOCK == 0
+        and head_dim >= 8
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "logits_soft_cap"))
+def _flash(q, k, v, segment_ids, causal, scale, logits_soft_cap):
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        SegmentIds,
+        flash_attention,
+    )
+
+    B, Hq, S, D = q.shape
+    Skv = k.shape[2]
+    seg = None
+    if segment_ids is not None:
+        seg = SegmentIds(q=segment_ids, kv=segment_ids)
+    block = min(_BLOCK * 4, S)
+    block_kv = min(_BLOCK * 4, Skv)
+    sizes = BlockSizes(
+        block_q=block, block_k_major=block_kv, block_k=block_kv,
+        block_b=1,
+        block_q_major_dkv=block, block_k_major_dkv=block_kv,
+        block_k_dkv=block_kv, block_q_dkv=block,
+        block_k_major_dq=block_kv, block_k_dq=block_kv, block_q_dq=block,
+    )
+    return flash_attention(
+        q, k, v, segment_ids=seg, causal=causal, sm_scale=scale,
+        block_sizes=sizes)
+
+
+def flash_attention_bshd(
+    q: jnp.ndarray,                         # [B, S, Hq, D]
+    k: jnp.ndarray,                         # [B, Skv, Hk, D]
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jnp.ndarray] = None,   # [B, S]
+    attention_mask: Optional[jnp.ndarray] = None,  # [B, Skv] padding mask
+    scale: Optional[float] = None,
+    logits_soft_cap: Optional[float] = None,
+) -> jnp.ndarray:
+    """Pallas flash attention in the framework's [B, S, H, D] convention.
+
+    GQA is handled by repeating kv heads (a splash-attention MQA path can
+    remove the repeat later).  Padding masks fold into segment ids: pad
+    positions get segment 0, which real tokens (segments >= 1) never attend
+    to.
+    """
+    B, S, Hq, D = q.shape
+    Hk = k.shape[2]
+    assert Hq % Hk == 0
+    if logits_soft_cap is not None:
+        raise NotImplementedError("soft cap not supported by the flash path")
+    scale = D ** -0.5 if scale is None else scale
+
+    if attention_mask is not None:
+        base = (segment_ids if segment_ids is not None
+                else jnp.ones((B, S), jnp.int32))
+        segment_ids = jnp.where(attention_mask.astype(bool), base, 0)
+    if segment_ids is not None:
+        segment_ids = segment_ids.astype(jnp.int32)
+
+    # [B, S, H, D] -> [B, H, S, D]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    if Hk != Hq:
+        rep = Hq // Hk
+        kt = jnp.repeat(kt, rep, axis=1)
+        vt = jnp.repeat(vt, rep, axis=1)
+    out = _flash(qt, kt, vt, segment_ids, causal, scale, logits_soft_cap)
+    return out.transpose(0, 2, 1, 3)
+
+
+def sharded_flash_attention(
+    q, k, v, mesh, *,
+    causal: bool = True,
+    segment_ids=None,
+    attention_mask=None,
+    scale=None,
+    batch_axes=("dp_replicate", "dp_shard"),
+    head_axis: str = "tp",
+):
+    """shard_map wrapper: a pallas_call must run per-shard under GSPMD, so
+    batch goes over dp and heads over tp; seq stays whole (cp=1 path — cp>1
+    routes to ring attention instead)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    qspec = P(tuple(batch_axes), None, head_axis, None)
+    kvspec = P(tuple(batch_axes), None, head_axis, None)
+    sspec = P(tuple(batch_axes), None)
+
+    B, S, Hq, D = q.shape
+    if attention_mask is not None:
+        base = (segment_ids if segment_ids is not None
+                else jnp.ones((B, S), jnp.int32))
+        segment_ids = jnp.where(attention_mask.astype(bool), base, 0)
+
+    def inner(q, k, v, seg):
+        return flash_attention_bshd(
+            q, k, v, causal=causal, segment_ids=seg, scale=scale)
+
+    if segment_ids is None:
+        return shard_map(
+            lambda q, k, v: inner(q, k, v, None), mesh=mesh,
+            in_specs=(qspec, kvspec, kvspec), out_specs=qspec,
+            check_vma=False)(q, k, v)
+    return shard_map(
+        inner, mesh=mesh,
+        in_specs=(qspec, kvspec, kvspec, sspec), out_specs=qspec,
+        check_vma=False)(q, k, v, segment_ids.astype(jnp.int32))

@@ -1,0 +1,153 @@
+"""Ring attention: context-parallel attention over the ``cp`` mesh axis.
+
+TPU-native replacement for the reference's torch-experimental
+``context_parallel`` (``nemo_automodel/components/distributed/cp_utils.py:
+34-149``, rotate method "allgather"/"alltoall"): here the canonical
+blockwise-ring formulation — each cp shard holds a sequence slice of
+q/k/v; k/v blocks rotate around the ring via ``jax.lax.ppermute`` while
+every shard accumulates its queries' attention with numerically-stable
+online-softmax (running max / sum) combination.  XLA overlaps the ppermute
+with the local block's compute, so the ring rides the ICI at full duplex
+(the scaling-book recipe).
+
+Causality: query positions are globally offset by ``shard_index * S_local``;
+a kv block arriving from ring step ``t`` carries offset
+``(my_index - t) % cp * S_local``.  Blocks entirely in the future are
+skipped mathematically (their contribution multiplies to zero weight)
+without data-dependent control flow, keeping one compiled program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _block_attend(q, k, v, mask) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One q-block x kv-block attention: returns (unnormalized out, row max,
+    row sumexp) in fp32. q:[B,Sq,Hk,G,D] k/v:[B,Skv,Hk,D] mask:[B,1,Sq,Skv]."""
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", q, k).astype(jnp.float32)
+    if mask is not None:
+        logits = jnp.where(mask[:, :, None], logits, _NEG_INF)
+    m = jnp.max(logits, axis=-1)                        # [B,Hk,G,Sq]
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
+    m_safe = jnp.maximum(m, -1e30)
+    p = jnp.exp(logits - m_safe[..., None])
+    if mask is not None:
+        p = jnp.where(mask[:, :, None], p, 0.0)
+    s = jnp.sum(p, axis=-1)                             # [B,Hk,G,Sq]
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v.dtype), v)
+    return out.astype(jnp.float32), m_safe, s
+
+
+def ring_attention(
+    q: jnp.ndarray,                       # [B, S_local, Hq, D] (per cp shard)
+    k: jnp.ndarray,                       # [B, S_local, Hk, D]
+    v: jnp.ndarray,
+    *,
+    axis_name: str = "cp",
+    causal: bool = True,
+    segment_ids: Optional[jnp.ndarray] = None,   # [B, S_local]
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Blockwise ring attention; call inside ``shard_map`` with the sequence
+    dim sharded over ``axis_name``.  GQA-native (no kv-head repeat)."""
+    B, S, Hq, D = q.shape
+    Hk = k.shape[2]
+    G = Hq // Hk
+    scale = D ** -0.5 if scale is None else scale
+    cp = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+
+    qg = (q * scale).reshape(B, S, Hk, G, D)
+    q_pos = my_idx * S + jnp.arange(S)                  # global query positions
+
+    def step_mask(kv_idx, seg_kv):
+        kv_pos = kv_idx * S + jnp.arange(S)
+        masks = []
+        if causal:
+            masks.append(q_pos[:, None] >= kv_pos[None, :])   # [Sq, Skv]
+        if segment_ids is not None:
+            seg = segment_ids[:, None, :, None] == seg_kv[:, None, None, :]
+            seg &= (seg_kv != 0)[:, None, None, :]
+            masks.append(seg)
+        if not masks:
+            return None
+        out = masks[0] if masks[0].ndim == 4 else masks[0][None, None]
+        for m in masks[1:]:
+            mm = m if m.ndim == 4 else m[None, None]
+            out = out & mm
+        return out
+
+    def body(carry, t):
+        k_t, v_t, seg_t, acc, m_run, s_run = carry
+        kv_idx = (my_idx - t) % cp
+        mask = step_mask(kv_idx, seg_t)
+        out_b, m_b, s_b = _block_attend(qg, k_t, v_t, mask)
+
+        m_new = jnp.maximum(m_run, m_b)
+        alpha = jnp.exp(m_run - m_new)                  # rescale old acc
+        beta = jnp.exp(m_b - m_new)
+        acc = acc * alpha[..., None].transpose(0, 3, 1, 2, 4) \
+            + out_b * beta[..., None].transpose(0, 3, 1, 2, 4)
+        s_run = s_run * alpha + s_b * beta
+        # rotate kv to the next shard (step t+1 sees neighbor's block)
+        perm = [(i, (i + 1) % cp) for i in range(cp)]
+        k_t = lax.ppermute(k_t, axis_name, perm)
+        v_t = lax.ppermute(v_t, axis_name, perm)
+        if seg_t is not None:
+            seg_t = lax.ppermute(seg_t, axis_name, perm)
+        return (k_t, v_t, seg_t, acc, m_run := m_new, s_run), None
+
+    acc0 = jnp.zeros((B, S, Hk, G, D), jnp.float32)
+    m0 = jnp.full((B, Hk, G, S), _NEG_INF, jnp.float32)
+    s0 = jnp.zeros((B, Hk, G, S), jnp.float32)
+    carry = (k, v, segment_ids, acc0, m0, s0)
+    (k_f, v_f, seg_f, acc, m_run, s_run), _ = lax.scan(
+        body, carry, jnp.arange(cp))
+
+    denom = jnp.maximum(s_run, 1e-30)                   # [B,Hk,G,Sq]
+    out = acc / denom[..., None].transpose(0, 3, 1, 2, 4)
+    return out.reshape(B, S, Hq, D).astype(q.dtype)
+
+
+def sharded_ring_attention(
+    q, k, v, mesh, *,
+    causal: bool = True,
+    segment_ids=None,
+    scale=None,
+    batch_axes=("dp_replicate", "dp_shard"),
+    seq_axis: str = "cp",
+    head_axis: str = "tp",
+):
+    """shard_map wrapper: [B, S, H, D] global arrays with S sharded over cp,
+    heads over tp, batch over dp -> ring attention per shard."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    qspec = P(tuple(batch_axes), seq_axis, head_axis, None)
+    sspec = P(tuple(batch_axes), seq_axis)
+
+    fn = functools.partial(
+        ring_attention, axis_name=seq_axis, causal=causal, scale=scale)
+
+    if segment_ids is None:
+        def wrapped(q, k, v):
+            return fn(q, k, v, segment_ids=None)
+
+        return shard_map(
+            wrapped, mesh=mesh, in_specs=(qspec, qspec, qspec),
+            out_specs=qspec, check_vma=False)(q, k, v)
+
+    def wrapped(q, k, v, seg):
+        return fn(q, k, v, segment_ids=seg)
+
+    return shard_map(
+        wrapped, mesh=mesh, in_specs=(qspec, qspec, qspec, sspec),
+        out_specs=qspec, check_vma=False)(q, k, v, segment_ids)

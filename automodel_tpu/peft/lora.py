@@ -352,21 +352,7 @@ def load_adapters(model: LoRAModel, params, adapter_dir: str, shardings=None):
 
 
 # ---------------------------------------------------------------------------
-def _flatten(tree, prefix=()):
-    out = {}
-    if isinstance(tree, dict):
-        for k, v in tree.items():
-            out.update(_flatten(v, prefix + (k,)))
-    else:
-        out[prefix] = tree
-    return out
-
-
-def _unflatten(flat):
-    out: Dict[str, Any] = {}
-    for path, v in flat.items():
-        node = out
-        for part in path[:-1]:
-            node = node.setdefault(part, {})
-        node[path[-1]] = v
-    return out
+from automodel_tpu.utils.pytree import (  # noqa: E402
+    flatten_path_dict as _flatten,
+    unflatten_path_dict as _unflatten,
+)

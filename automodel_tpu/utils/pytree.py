@@ -1,0 +1,25 @@
+"""Path-keyed pytree flatten/unflatten shared by hf_io and peft."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+
+def flatten_path_dict(tree: Any, prefix: Tuple[str, ...] = ()) -> Dict[Tuple[str, ...], Any]:
+    out: Dict[Tuple[str, ...], Any] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(flatten_path_dict(v, prefix + (str(k),)))
+    else:
+        out[prefix] = tree
+    return out
+
+
+def unflatten_path_dict(flat: Dict[Tuple[str, ...], Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for path, v in flat.items():
+        node = out
+        for part in path[:-1]:
+            node = node.setdefault(part, {})
+        node[path[-1]] = v
+    return out

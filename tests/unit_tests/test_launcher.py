@@ -1,0 +1,52 @@
+"""SLURM launcher: script rendering + no-resubmission guarantees."""
+
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.launcher.slurm.config import SlurmConfig, VolumeMapping
+from automodel_tpu.launcher.slurm.utils import render_slurm_script
+
+
+def test_render_minimal_script_no_empty_directives():
+    s = render_slurm_script(SlurmConfig(nodes=2, hf_home=""), "python x.py")
+    assert "#SBATCH -A" not in s       # empty account line omitted
+    assert "#SBATCH -p" not in s
+    assert "export HF_HOME=\n" not in s
+    assert "#SBATCH -N 2" in s
+    assert "python x.py" in s
+    assert "srun" in s
+
+
+def test_render_full_script():
+    cfg = SlurmConfig(
+        job_name="j", account="acct", partition="part", nodes=4,
+        container_image="img:latest",
+        extra_mounts=[VolumeMapping("/a", "/b")],
+        env_vars={"FOO": "bar"}, hf_home="/hf")
+    s = render_slurm_script(cfg, "run")
+    assert "#SBATCH -A acct" in s
+    assert "#SBATCH -p part" in s
+    assert "--container-image=img:latest" in s
+    assert "--container-mounts=/a:/b" in s
+    assert "export FOO=bar" in s
+    assert "export HF_HOME=/hf" in s
+
+
+def test_default_command_blocks_resubmission(tmp_path, monkeypatch):
+    import automodel_tpu.launcher.slurm.utils as U
+
+    captured = {}
+
+    def fake_run(cmd, **kw):
+        captured["script"] = open(cmd[1]).read()
+
+        class R:
+            stdout = "Submitted batch job 123"
+        return R()
+
+    monkeypatch.setattr(U.subprocess, "run", fake_run)
+    cfg = ConfigNode({"slurm": {"nodes": 1, "job_dir": str(tmp_path)}})
+    job = U.submit_slurm_job(cfg, "finetune", "llm", "cfg.yaml",
+                             overrides=["--optimizer.lr", "1e-4"])
+    assert job == "123"
+    # job command forwards overrides and disables the slurm section
+    assert "--optimizer.lr 1e-4" in captured["script"]
+    assert "--slurm none" in captured["script"]
