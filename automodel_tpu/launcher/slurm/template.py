@@ -1,9 +1,10 @@
 """sbatch script template for TPU-pod SLURM clusters.
 
 Reference parity: ``nemo_automodel/components/launcher/slurm/template.py:42-87``
-— same header/env/command structure, with the torchrun/NCCL env replaced by
-``jax.distributed`` coordinator variables (one task per host; JAX picks up
-``COORDINATOR_ADDRESS``/process ids via ``initialize_distributed``).
+— same header/env/command structure.  No torchrun/MASTER_ADDR equivalent is
+rendered: ``jax.distributed.initialize`` autodetects SLURM clusters
+(coordinator from ``SLURM_JOB_NODELIST``, process id from ``SLURM_PROCID``
+inside each srun task), so the script only carries experiment env.
 """
 
 from __future__ import annotations

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 import re
+import shlex
 import subprocess
 from typing import Optional
 
@@ -57,7 +58,7 @@ def submit_slurm_job(cfg, command: str = "finetune", domain: str = "llm",
     fields = {k: v for k, v in slurm_cfg.to_dict().items()}
     # `--slurm none` stops the in-job CLI from resubmitting itself; user
     # overrides are forwarded so SLURM runs match local runs.
-    fwd = " ".join(str(o) for o in (overrides or []))
+    fwd = " ".join(shlex.quote(str(o)) for o in (overrides or []))
     run_cmd = fields.pop("command", None) or (
         f"python -m automodel_tpu._cli.app {command} {domain} "
         f"-c {config_path} {fwd} --slurm none".strip())

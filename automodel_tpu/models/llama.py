@@ -26,6 +26,7 @@ from jax import lax
 from automodel_tpu.distributed.shardings import constrain
 from automodel_tpu.ops.attention import attention
 from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.quant import maybe_qdot
 from automodel_tpu.ops.rotary import apply_rope, rope_frequencies
 
 
@@ -96,6 +97,7 @@ class LlamaForCausalLM:
         self.compute_dtype = jnp.dtype(compute_dtype)
         self.remat = remat
         self.remat_policy = remat_policy
+        self.quant = None  # set by quantization.fp8.apply_fp8_to_model
         self.inv_freq = rope_frequencies(
             config.head_dim, config.rope_theta, config.rope_scaling
         )
@@ -196,7 +198,7 @@ class LlamaForCausalLM:
         cd = self.compute_dtype
 
         def proj(x, w, name):
-            y = x @ w["kernel"].astype(cd)
+            y = maybe_qdot(x, w["kernel"].astype(cd), self.quant, name)
             if "bias" in w:
                 y = y + w["bias"].astype(cd)
             return y
@@ -204,9 +206,9 @@ class LlamaForCausalLM:
         # Attention block
         resid = hidden
         x = rms_norm(hidden, p["input_layernorm"]["weight"], cfg.rms_norm_eps)
-        q = proj(x, p["self_attn"]["q_proj"], "q").reshape(B, S, Hq, D)
-        k = proj(x, p["self_attn"]["k_proj"], "k").reshape(B, S, Hk, D)
-        v = proj(x, p["self_attn"]["v_proj"], "v").reshape(B, S, Hk, D)
+        q = proj(x, p["self_attn"]["q_proj"], "self_attn.q_proj").reshape(B, S, Hq, D)
+        k = proj(x, p["self_attn"]["k_proj"], "self_attn.k_proj").reshape(B, S, Hk, D)
+        v = proj(x, p["self_attn"]["v_proj"], "self_attn.v_proj").reshape(B, S, Hk, D)
         if cfg.qk_norm:
             q = rms_norm(q, p["self_attn"]["q_norm"]["weight"], cfg.rms_norm_eps)
             k = rms_norm(k, p["self_attn"]["k_norm"]["weight"], cfg.rms_norm_eps)
@@ -217,15 +219,21 @@ class LlamaForCausalLM:
             segment_ids=segment_ids,
             attention_mask=attention_mask,
         )
-        attn = attn.reshape(B, S, Hq * D) @ p["self_attn"]["o_proj"]["kernel"].astype(cd)
+        attn = maybe_qdot(attn.reshape(B, S, Hq * D),
+                          p["self_attn"]["o_proj"]["kernel"].astype(cd),
+                          self.quant, "self_attn.o_proj")
         hidden = resid + attn
 
         # MLP block (SwiGLU)
         resid = hidden
         x = rms_norm(hidden, p["post_attention_layernorm"]["weight"], cfg.rms_norm_eps)
-        gate = x @ p["mlp"]["gate_proj"]["kernel"].astype(cd)
-        up = x @ p["mlp"]["up_proj"]["kernel"].astype(cd)
-        down = (jax.nn.silu(gate) * up) @ p["mlp"]["down_proj"]["kernel"].astype(cd)
+        gate = maybe_qdot(x, p["mlp"]["gate_proj"]["kernel"].astype(cd),
+                          self.quant, "mlp.gate_proj")
+        up = maybe_qdot(x, p["mlp"]["up_proj"]["kernel"].astype(cd),
+                        self.quant, "mlp.up_proj")
+        down = maybe_qdot(jax.nn.silu(gate) * up,
+                          p["mlp"]["down_proj"]["kernel"].astype(cd),
+                          self.quant, "mlp.down_proj")
         # SP/CP activation layout between blocks (no-op without a sharding ctx)
         return constrain(resid + down, ("act_batch", "act_seq", "act_embed"))
 

@@ -54,6 +54,20 @@ def make_attention_mask(
     return out
 
 
+def fold_padding_into_segments(
+    batch_shape: tuple,
+    segment_ids: Optional[jnp.ndarray],
+    attention_mask: Optional[jnp.ndarray],
+) -> Optional[jnp.ndarray]:
+    """Single place that encodes the padding convention: pad positions get
+    segment 0, which real tokens (segments >= 1) never attend to."""
+    if attention_mask is None:
+        return segment_ids if segment_ids is None else segment_ids.astype(jnp.int32)
+    base = (segment_ids if segment_ids is not None
+            else jnp.ones(batch_shape, jnp.int32))
+    return jnp.where(attention_mask.astype(bool), base, 0).astype(jnp.int32)
+
+
 def dot_product_attention(
     q: jnp.ndarray,  # [B, Sq, Hq, D]
     k: jnp.ndarray,  # [B, Skv, Hk, D]
@@ -128,11 +142,8 @@ def attention(
         if "cp" in mesh.shape and mesh.shape["cp"] > 1 and logits_soft_cap is None:
             from automodel_tpu.ops.ring_attention import sharded_ring_attention
 
-            seg = segment_ids
-            if attention_mask is not None:
-                base = seg if seg is not None else jnp.ones(
-                    attention_mask.shape, jnp.int32)
-                seg = jnp.where(attention_mask.astype(bool), base, 0)
+            seg = fold_padding_into_segments(
+                q.shape[:2], segment_ids, attention_mask)
             return sharded_ring_attention(
                 q, k, v, mesh, causal=causal, segment_ids=seg, scale=scale)
 

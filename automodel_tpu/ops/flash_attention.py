@@ -54,8 +54,16 @@ def _flash(q, k, v, segment_ids, causal, scale, logits_soft_cap):
     seg = None
     if segment_ids is not None:
         seg = SegmentIds(q=segment_ids, kv=segment_ids)
-    block = min(_BLOCK * 4, S)
-    block_kv = min(_BLOCK * 4, Skv)
+
+    def pick_block(n):
+        # largest pallas-legal block that divides the sequence length
+        for b in (512, 256, 128):
+            if n % b == 0:
+                return b
+        return n  # n is a multiple of 128 < 512 handled above; fallback
+
+    block = min(pick_block(S), S)
+    block_kv = min(pick_block(Skv), Skv)
     sizes = BlockSizes(
         block_q=block, block_k_major=block_kv, block_k=block_kv,
         block_b=1,
@@ -93,12 +101,10 @@ def flash_attention_bshd(
         raise NotImplementedError("soft cap not supported by the flash path")
     scale = D ** -0.5 if scale is None else scale
 
-    if attention_mask is not None:
-        base = (segment_ids if segment_ids is not None
-                else jnp.ones((B, S), jnp.int32))
-        segment_ids = jnp.where(attention_mask.astype(bool), base, 0)
-    if segment_ids is not None:
-        segment_ids = segment_ids.astype(jnp.int32)
+    from automodel_tpu.ops.attention import fold_padding_into_segments
+
+    segment_ids = fold_padding_into_segments((B, S), segment_ids,
+                                             attention_mask)
 
     # [B, S, H, D] -> [B, H, S, D]
     qt = q.transpose(0, 2, 1, 3)
@@ -131,11 +137,11 @@ def sharded_flash_attention(
     kvspec = P(tuple(batch_axes), None, head_axis, None)
     sspec = P(tuple(batch_axes), None)
 
+    from automodel_tpu.ops.attention import fold_padding_into_segments
+
     B, S, Hq, D = q.shape
-    if attention_mask is not None:
-        base = (segment_ids if segment_ids is not None
-                else jnp.ones((B, S), jnp.int32))
-        segment_ids = jnp.where(attention_mask.astype(bool), base, 0)
+    segment_ids = fold_padding_into_segments((B, S), segment_ids,
+                                             attention_mask)
 
     def inner(q, k, v, seg):
         return flash_attention_bshd(

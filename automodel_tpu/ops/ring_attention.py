@@ -66,51 +66,54 @@ def ring_attention(
     my_idx = lax.axis_index(axis_name)
 
     qg = (q * scale).reshape(B, S, Hk, G, D)
-    q_pos = my_idx * S + jnp.arange(S)                  # global query positions
 
     def step_mask(kv_idx, seg_kv):
-        kv_pos = kv_idx * S + jnp.arange(S)
-        masks = []
-        if causal:
-            masks.append(q_pos[:, None] >= kv_pos[None, :])   # [Sq, Skv]
-        if segment_ids is not None:
-            seg = segment_ids[:, None, :, None] == seg_kv[:, None, None, :]
-            seg &= (seg_kv != 0)[:, None, None, :]
-            masks.append(seg)
-        if not masks:
-            return None
-        out = masks[0] if masks[0].ndim == 4 else masks[0][None, None]
-        for m in masks[1:]:
-            mm = m if m.ndim == 4 else m[None, None]
-            out = out & mm
-        return out
+        from automodel_tpu.ops.attention import make_attention_mask
 
-    def body(carry, t):
-        k_t, v_t, seg_t, acc, m_run, s_run = carry
+        # reuse the canonical mask builder: global positions expressed as a
+        # query offset relative to the arriving kv block
+        return make_attention_mask(
+            S, S, causal=causal,
+            segment_ids_q=segment_ids, segment_ids_kv=seg_kv,
+            q_offset=(my_idx - kv_idx) * S)
+
+    def attend_and_combine(state, k_t, v_t, seg_t, t):
+        acc, m_run, s_run = state
         kv_idx = (my_idx - t) % cp
-        mask = step_mask(kv_idx, seg_t)
-        out_b, m_b, s_b = _block_attend(qg, k_t, v_t, mask)
-
+        out_b, m_b, s_b = _block_attend(qg, k_t, v_t, step_mask(kv_idx, seg_t))
         m_new = jnp.maximum(m_run, m_b)
         alpha = jnp.exp(m_run - m_new)                  # rescale old acc
         beta = jnp.exp(m_b - m_new)
         acc = acc * alpha[..., None].transpose(0, 3, 1, 2, 4) \
             + out_b * beta[..., None].transpose(0, 3, 1, 2, 4)
         s_run = s_run * alpha + s_b * beta
+        return acc, m_new, s_run
+
+    def body(carry, t):
+        k_t, v_t, seg_t, *state = carry
+        state = attend_and_combine(tuple(state), k_t, v_t, seg_t, t)
         # rotate kv to the next shard (step t+1 sees neighbor's block)
         perm = [(i, (i + 1) % cp) for i in range(cp)]
         k_t = lax.ppermute(k_t, axis_name, perm)
         v_t = lax.ppermute(v_t, axis_name, perm)
         if seg_t is not None:
             seg_t = lax.ppermute(seg_t, axis_name, perm)
-        return (k_t, v_t, seg_t, acc, m_run := m_new, s_run), None
+        return (k_t, v_t, seg_t, *state), None
 
     acc0 = jnp.zeros((B, S, Hk, G, D), jnp.float32)
     m0 = jnp.full((B, Hk, G, S), _NEG_INF, jnp.float32)
     s0 = jnp.zeros((B, Hk, G, S), jnp.float32)
-    carry = (k, v, segment_ids, acc0, m0, s0)
-    (k_f, v_f, seg_f, acc, m_run, s_run), _ = lax.scan(
-        body, carry, jnp.arange(cp))
+    if cp == 1:
+        acc, m_run, s_run = attend_and_combine((acc0, m0, s0), k, v,
+                                               segment_ids, 0)
+    else:
+        # scan the first cp-1 blocks (each ends with a rotation), then attend
+        # the final arriving block without a wasted trailing ppermute
+        carry = (k, v, segment_ids, acc0, m0, s0)
+        (k_f, v_f, seg_f, *state), _ = lax.scan(
+            body, carry, jnp.arange(cp - 1))
+        acc, m_run, s_run = attend_and_combine(
+            tuple(state), k_f, v_f, seg_f, cp - 1)
 
     denom = jnp.maximum(s_run, 1e-30)                   # [B,Hk,G,Sq]
     out = acc / denom[..., None].transpose(0, 3, 1, 2, 4)

@@ -1,0 +1,90 @@
+"""FP8/int8 training configuration surface.
+
+Reference parity: ``nemo_automodel/components/quantization/fp8.py:28-339``
+(``FP8Config``, ``build_fp8_config``, ``apply_fp8_to_model``,
+``verify_fp8_conversion``).  The TPU mechanism is functional: applying fp8
+sets a :class:`~automodel_tpu.ops.quant.QuantConfig` on the model, and the
+model's matmuls route through ``ops.quant.maybe_qdot`` — no module swapping.
+torchao-only knobs (fsdp fp8 all-gather, scale precompute) are accepted and
+ignored: XLA manages collective precision itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import List, Optional
+
+from automodel_tpu.ops.quant import QuantConfig
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class FP8Config:
+    enabled: bool = False
+    recipe_name: Optional[str] = "tensorwise"
+    dtype: str = "float8"                      # "float8" | "int8"
+    filter_fqns: List[str] = dataclasses.field(default_factory=list)
+    emulate: bool = False
+    # torchao-only knobs, accepted for YAML parity (no-ops under XLA):
+    enable_fsdp_float8_all_gather: bool = False
+    precompute_float8_dynamic_scale_for_fsdp: bool = False
+    force_recompute_fp8_weight_in_bwd: bool = False
+
+    def to_quant_config(self) -> QuantConfig:
+        return QuantConfig(
+            enabled=self.enabled,
+            recipe_name=self.recipe_name or "tensorwise",
+            dtype=self.dtype,
+            filter_fqns=list(self.filter_fqns),
+            emulate=self.emulate,
+        )
+
+
+def build_fp8_config(cfg=None, **kwargs) -> FP8Config:
+    fields = {f.name for f in dataclasses.fields(FP8Config)}
+    if cfg is not None:
+        data = cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg)
+        kwargs = {**{k: v for k, v in data.items() if k in fields}, **kwargs}
+    return FP8Config(**{k: v for k, v in kwargs.items() if k in fields})
+
+
+def apply_fp8_to_model(model, config: Optional[FP8Config] = None, **kwargs):
+    """Enable quantized compute on a functional model (sets ``model.quant``)."""
+    config = config or build_fp8_config(**kwargs)
+    target = getattr(model, "base_model", model)   # through LoRA wrappers
+    if not config.enabled:
+        return model
+    target.quant = config.to_quant_config()
+    logger.info("Quantized compute enabled: %s/%s",
+                config.dtype, config.recipe_name)
+    return model
+
+
+def verify_fp8_conversion(model) -> dict:
+    """Count quantizable matmuls (>=16-aligned dims), reference
+    ``fp8.py:265``-style report."""
+    target = getattr(model, "base_model", model)
+    quant = getattr(target, "quant", None)
+    flat = []
+
+    def walk(tree, prefix=()):
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                walk(v, prefix + (k,))
+        elif prefix and prefix[-1] == "kernel" and len(tree.shape) >= 2:
+            flat.append((".".join(prefix[:-1]), tree.shape))
+
+    walk(target.abstract_params())
+    eligible = [
+        (n, s) for n, s in flat
+        if s[-1] % 16 == 0 and s[-2] % 16 == 0
+        and not (quant and any(f in n for f in quant.filter_fqns))
+    ]
+    return {
+        "enabled": bool(quant and quant.enabled),
+        "total_linears": len(flat),
+        "converted": len(eligible) if quant and quant.enabled else 0,
+        "skipped": len(flat) - (len(eligible) if quant and quant.enabled else 0),
+    }

@@ -208,6 +208,16 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                         if isinstance(loss_cfg, ConfigNode) and "_target_" in loss_cfg
                         else MaskedCrossEntropy())
 
+        # FP8/int8 quantized compute (optional)
+        fp8_cfg = cfg.get("fp8")
+        if fp8_cfg is not None:
+            from automodel_tpu.quantization.fp8 import (
+                apply_fp8_to_model,
+                build_fp8_config,
+            )
+
+            apply_fp8_to_model(self.model, build_fp8_config(fp8_cfg))
+
         # PEFT (optional)
         self.peft_config = None
         peft_cfg = cfg.get("peft")

@@ -1,0 +1,79 @@
+"""Model utilities: parameter counting and freezing.
+
+Reference parity: ``nemo_automodel/components/utils/model_utils.py:50-133``
+(``print_trainable_parameters``, ``apply_parameter_freezing`` by attr name +
+regex patterns).  In the functional world "freezing" is an optax mask
+(True = trainable), consumed by ``build_optimizer(mask=...)``.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def count_parameters(params: Any) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+
+
+def print_trainable_parameters(params: Any, mask: Optional[Any] = None,
+                               log=logger.info) -> Dict[str, int]:
+    total = count_parameters(params)
+    if mask is None:
+        trainable = total
+    else:
+        trainable = sum(
+            int(np.prod(p.shape))
+            for p, m in zip(jax.tree.leaves(params), jax.tree.leaves(mask))
+            if m)
+    log("trainable params: %s || all params: %s || trainable%%: %.4f",
+        f"{trainable:,}", f"{total:,}",
+        100.0 * trainable / max(total, 1))
+    return {"trainable": trainable, "total": total}
+
+
+def make_freeze_mask(
+    abstract_params: Any,
+    freeze_patterns: Optional[List[str]] = None,
+    freeze_embeddings: bool = False,
+    freeze_vision_tower: bool = False,
+    freeze_language_model: bool = False,
+) -> Any:
+    """Optax mask (True = trainable) from the reference's freezing knobs
+    (``apply_parameter_freezing``: embed / vision_tower / language_model
+    regexes + arbitrary patterns)."""
+    patterns = list(freeze_patterns or [])
+    if freeze_embeddings:
+        patterns.append(r".*(embed|wte|wpe).*")
+    if freeze_vision_tower:
+        patterns.append(r".*(vision_tower|vision_model).*")
+    if freeze_language_model:
+        patterns.append(r".*(language_model|layers).*")
+    compiled = [re.compile(p) for p in patterns]
+
+    def leaf_mask(path, _leaf) -> bool:
+        name = ".".join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        return not any(rx.fullmatch(name) or rx.match(name) for rx in compiled)
+
+    return jax.tree_util.tree_map_with_path(leaf_mask, abstract_params)
+
+
+def apply_parameter_freezing(abstract_params: Any, freeze_config) -> Any:
+    """YAML-driven freezing -> optax mask (reference ``model_utils.py:80``)."""
+    cfg = freeze_config.to_dict() if hasattr(freeze_config, "to_dict") else dict(
+        freeze_config or {})
+    return make_freeze_mask(
+        abstract_params,
+        freeze_patterns=cfg.get("freeze_patterns"),
+        freeze_embeddings=cfg.get("freeze_embeddings", False),
+        freeze_vision_tower=cfg.get("freeze_vision_tower", True)
+        if "freeze_vision_tower" in cfg else False,
+        freeze_language_model=cfg.get("freeze_language_model", False),
+    )

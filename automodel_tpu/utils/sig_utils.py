@@ -1,0 +1,61 @@
+"""Distributed signal handling: graceful preemption detection.
+
+Reference parity: ``nemo_automodel/components/utils/sig_utils.py:51-168``
+(``DistributedSignalHandler``: trap SIGTERM, all-gather the flag so every
+rank learns of a preemption even when only one host received the signal).
+The all-gather is ``multihost_utils.process_allgather`` — every process must
+call :meth:`signals_received` collectively (e.g. once per checkpoint window).
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Optional
+
+import numpy as np
+
+
+class DistributedSignalHandler:
+    def __init__(self, sig: int = signal.SIGTERM):
+        self.sig = sig
+        self._received = False
+        self._prev_handler = None
+
+    # -- context -----------------------------------------------------------
+    def __enter__(self):
+        self._received = False
+        self._prev_handler = signal.getsignal(self.sig)
+        signal.signal(self.sig, self._handler)
+        return self
+
+    def __exit__(self, *exc):
+        if self._prev_handler is not None:
+            signal.signal(self.sig, self._prev_handler)
+        return False
+
+    def _handler(self, signum, frame):
+        self._received = True
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def received(self) -> bool:
+        return self._received
+
+    def signals_received(self) -> bool:
+        """True if ANY process received the signal.  Collective call."""
+        import jax
+
+        if jax.process_count() == 1:
+            return self._received
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([1 if self._received else 0], np.int32))
+        return bool(np.any(flags))
+
+
+def get_signal_name(sig: Optional[int]) -> str:
+    try:
+        return signal.Signals(sig).name
+    except (ValueError, TypeError):
+        return str(sig)

@@ -47,6 +47,15 @@ def main() -> None:
 
     model = LlamaForCausalLM(cfg, param_dtype=jnp.bfloat16,
                              compute_dtype=jnp.bfloat16, remat=True)
+    quant = os.environ.get("BENCH_QUANT", "")   # "" | "int8" | "float8"
+    if quant:
+        from automodel_tpu.quantization.fp8 import (
+            apply_fp8_to_model,
+            build_fp8_config,
+        )
+
+        apply_fp8_to_model(model, build_fp8_config(
+            enabled=True, dtype=quant, recipe_name="tensorwise"))
     tx = build_optimizer(name="adamw", lr=1e-4, weight_decay=0.01,
                          mu_dtype=jnp.bfloat16)
     fns = build_train_step(

@@ -1,0 +1,61 @@
+"""Aux utils: loggers, signal handler, freeze masks."""
+
+import logging
+import os
+import signal
+
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.loggers.log_utils import ColorFormatter, RankFilter, setup_logging
+from automodel_tpu.utils.model_utils import (
+    count_parameters,
+    make_freeze_mask,
+    print_trainable_parameters,
+)
+from automodel_tpu.utils.sig_utils import DistributedSignalHandler, get_signal_name
+
+
+def test_rank_filter_passes_on_rank0():
+    f = RankFilter(rank=0)
+    rec = logging.LogRecord("x", logging.INFO, "f", 1, "m", (), None)
+    assert f.filter(rec)
+    assert not RankFilter(rank=1).filter(rec)
+
+
+def test_setup_logging_runs(capsys):
+    setup_logging(logging_level=logging.INFO, rank_filter=False)
+    logging.getLogger("t").info("hello")
+    # restore defaults for other tests
+    logging.getLogger().handlers.clear()
+    logging.basicConfig()
+
+
+def test_color_formatter_plain():
+    fmt = ColorFormatter(use_color=False)
+    rec = logging.LogRecord("x", logging.WARNING, "f", 1, "msg", (), None)
+    assert "msg" in fmt.format(rec)
+
+
+def test_signal_handler_local():
+    with DistributedSignalHandler(signal.SIGUSR1) as h:
+        assert not h.signals_received()
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert h.received
+        assert h.signals_received()
+    assert get_signal_name(signal.SIGTERM) == "SIGTERM"
+
+
+def test_freeze_mask_and_counting():
+    params = {
+        "embed_tokens": {"embedding": jnp.ones((10, 4))},
+        "layers": {"mlp": {"kernel": jnp.ones((4, 4))}},
+        "lm_head": {"kernel": jnp.ones((4, 10))},
+    }
+    mask = make_freeze_mask(params, freeze_embeddings=True)
+    assert mask["embed_tokens"]["embedding"] is False
+    assert mask["layers"]["mlp"]["kernel"] is True
+    stats = print_trainable_parameters(params, mask, log=lambda *a: None)
+    assert stats["total"] == 10 * 4 + 16 + 40
+    assert stats["trainable"] == 16 + 40
+    assert count_parameters(params) == stats["total"]
