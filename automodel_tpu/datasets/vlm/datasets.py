@@ -1,0 +1,113 @@
+"""VLM dataset builders: conversation-format wrappers over HF datasets.
+
+Reference parity: ``nemo_automodel/components/datasets/vlm/datasets.py:23-136``
+(``make_rdr_dataset``, ``make_cord_v2_dataset``, ``make_medpix_dataset``,
+``make_cv17_dataset``).  Each sample is ``{"conversation": [...],
+"images": [PIL or array]}`` — the format ``COLLATE_FNS`` consume.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from automodel_tpu.datasets.vlm.utils import json2token
+
+
+def _limit(split: str, limit: Optional[int]) -> str:
+    return f"{split}[:{limit}]" if isinstance(limit, int) else split
+
+
+def make_rdr_dataset(path_or_dataset: str = "quintend/rdr-items",
+                     split: str = "train", limit_dataset_samples=None,
+                     **kwargs):
+    """RDR items: image -> description."""
+    from datasets import load_dataset
+
+    ds = load_dataset(path_or_dataset, split=_limit(split, limit_dataset_samples))
+
+    def fmt(ex):
+        return {
+            "conversation": [
+                {"role": "user", "content": [
+                    {"type": "image"},
+                    {"type": "text", "text": "Describe this image."}]},
+                {"role": "assistant", "content": [
+                    {"type": "text", "text": ex["text"]}]},
+            ],
+            "images": [ex["image"]],
+        }
+
+    return [fmt(ex) for ex in ds]
+
+
+def make_cord_v2_dataset(path_or_dataset: str = "naver-clova-ix/cord-v2",
+                         split: str = "train", limit_dataset_samples=None,
+                         **kwargs):
+    """CORD-v2 receipts: image -> Donut-style json2token ground truth."""
+    from datasets import load_dataset
+
+    ds = load_dataset(path_or_dataset, split=_limit(split, limit_dataset_samples))
+
+    def fmt(ex):
+        gt = json.loads(ex["ground_truth"])
+        parse = gt.get("gt_parse", gt)
+        return {
+            "conversation": [
+                {"role": "user", "content": [
+                    {"type": "image"},
+                    {"type": "text", "text": "Extract the text."}]},
+                {"role": "assistant", "content": [
+                    {"type": "text", "text": json2token(parse)}]},
+            ],
+            "images": [ex["image"]],
+        }
+
+    return [fmt(ex) for ex in ds]
+
+
+def make_medpix_dataset(path_or_dataset: str = "mmoukouba/MedPix-VQA",
+                        split: str = "train", limit_dataset_samples=None,
+                        **kwargs):
+    """MedPix VQA: medical image + question -> answer."""
+    from datasets import load_dataset
+
+    ds = load_dataset(path_or_dataset, split=_limit(split, limit_dataset_samples))
+
+    def fmt(ex):
+        return {
+            "conversation": [
+                {"role": "user", "content": [
+                    {"type": "image"},
+                    {"type": "text", "text": ex["question"]}]},
+                {"role": "assistant", "content": [
+                    {"type": "text", "text": ex["answer"]}]},
+            ],
+            "images": [ex["image"]],
+        }
+
+    return [fmt(ex) for ex in ds]
+
+
+def make_cv17_dataset(path_or_dataset: str = "ysdede/commonvoice_17_tr_fixed",
+                      split: str = "train", limit_dataset_samples=None,
+                      **kwargs):
+    """CommonVoice 17 audio: transcription conversations (audio modality)."""
+    from datasets import load_dataset
+
+    ds = load_dataset(path_or_dataset, split=_limit(split, limit_dataset_samples))
+
+    def fmt(ex):
+        return {
+            "conversation": [
+                {"role": "user", "content": [
+                    {"type": "audio"},
+                    {"type": "text",
+                     "text": "Transcribe the audio clip into text."}]},
+                {"role": "assistant", "content": [
+                    {"type": "text", "text": ex["sentence"]}]},
+            ],
+            "audio": ex["audio"],
+        }
+
+    return [fmt(ex) for ex in ds]

@@ -249,13 +249,29 @@ class LlamaForCausalLM:
         """Forward pass. Returns ``{"logits": ...}`` or, with ``return_hidden``,
         ``{"hidden_states": ..., "lm_head_kernel": ...}`` for fused linear CE
         (the reference's logits_to_keep path, ``recipes/llm/train_ft.py:436-460``)."""
+        hidden = params["embed_tokens"]["embedding"][input_ids].astype(self.compute_dtype)
+        return self.forward_embeds(
+            params, hidden, position_ids=position_ids,
+            segment_ids=segment_ids, attention_mask=attention_mask,
+            return_hidden=return_hidden)
+
+    def forward_embeds(
+        self,
+        params: Dict[str, Any],
+        hidden: jnp.ndarray,                    # [B, S, H] input embeddings
+        position_ids: Optional[jnp.ndarray] = None,
+        segment_ids: Optional[jnp.ndarray] = None,
+        attention_mask: Optional[jnp.ndarray] = None,
+        return_hidden: bool = False,
+    ) -> Dict[str, jnp.ndarray]:
+        """Forward from input embeddings — the VLM path (image features
+        already merged into the token stream)."""
         cfg = self.config
-        B, S = input_ids.shape
+        B, S = hidden.shape[:2]
         if position_ids is None:
             position_ids = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-
-        hidden = params["embed_tokens"]["embedding"][input_ids].astype(self.compute_dtype)
-        hidden = constrain(hidden, ("act_batch", "act_seq", "act_embed"))
+        hidden = constrain(hidden.astype(self.compute_dtype),
+                           ("act_batch", "act_seq", "act_embed"))
         inv_freq = jnp.asarray(self.inv_freq)
 
         def body(h, layer_params):

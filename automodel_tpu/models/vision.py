@@ -1,0 +1,168 @@
+"""SigLIP-style vision transformer — the VLM vision tower.
+
+TPU-native stand-in for the HF vision towers the reference loads through
+``NeMoAutoModelForImageTextToText`` (``nemo_automodel/components/
+_transformers/auto_model.py:415``; Gemma3/Qwen2.5-VL use SigLIP-family
+encoders).  Same stacked-layer + ``lax.scan`` design as the decoders: patch
+embedding as one big matmul (MXU-friendly; a conv with stride=kernel IS a
+patch matmul), learned position embeddings, pre-LN blocks with GELU MLP,
+non-causal attention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.norms import layer_norm
+
+
+@dataclasses.dataclass
+class VisionConfig:
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    image_size: int = 224
+    patch_size: int = 14
+    num_channels: int = 3
+    layer_norm_eps: float = 1e-6
+    model_type: str = "siglip_vision_model"
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def from_hf_config(cls, hf: Dict[str, Any]) -> "VisionConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in hf.items() if k in known})
+
+
+class VisionTower:
+    def __init__(self, config: VisionConfig,
+                 param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
+                 remat: bool = True):
+        self.config = config
+        self.param_dtype = jnp.dtype(param_dtype)
+        self.compute_dtype = jnp.dtype(compute_dtype)
+        self.remat = remat
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        cfg = self.config
+        L, H, I = cfg.num_hidden_layers, cfg.hidden_size, cfg.intermediate_size
+        P = cfg.patch_size * cfg.patch_size * cfg.num_channels
+        ks = iter(jax.random.split(key, 8))
+
+        def w(k, shape, layers=True, std=0.02):
+            full = (L, *shape) if layers else shape
+            return (jax.random.normal(k, full, jnp.float32) * std).astype(
+                self.param_dtype)
+
+        zeros = lambda s, layers=True: jnp.zeros(
+            (L, *s) if layers else s, self.param_dtype)
+        ones = lambda s, layers=True: jnp.ones(
+            (L, *s) if layers else s, self.param_dtype)
+        return {
+            "patch_embed": {"kernel": w(next(ks), (P, H), layers=False),
+                            "bias": zeros((H,), layers=False)},
+            "pos_embed": {"embedding": w(next(ks), (cfg.num_patches, H),
+                                         layers=False)},
+            "layers": {
+                "ln_1": {"weight": ones((H,)), "bias": zeros((H,))},
+                "attn": {
+                    "qkv": {"kernel": w(next(ks), (H, 3 * H)),
+                            "bias": zeros((3 * H,))},
+                    "out": {"kernel": w(next(ks), (H, H)),
+                            "bias": zeros((H,))},
+                },
+                "ln_2": {"weight": ones((H,)), "bias": zeros((H,))},
+                "mlp": {
+                    "fc1": {"kernel": w(next(ks), (H, I)), "bias": zeros((I,))},
+                    "fc2": {"kernel": w(next(ks), (I, H)), "bias": zeros((H,))},
+                },
+            },
+            "post_ln": {"weight": ones((H,), layers=False),
+                        "bias": zeros((H,), layers=False)},
+        }
+
+    def abstract_params(self):
+        return jax.eval_shape(self.init, jax.random.key(0))
+
+    def param_axes(self) -> Dict[str, Any]:
+        return {
+            "patch_embed": {"kernel": ("norm", "embed"), "bias": ("norm",)},
+            "pos_embed": {"embedding": ("pos", "embed")},
+            "layers": {
+                "ln_1": {"weight": ("layers", "norm"), "bias": ("layers", "norm")},
+                "attn": {
+                    "qkv": {"kernel": ("layers", "embed", "qkv3"),
+                            "bias": ("layers", "qkv3")},
+                    "out": {"kernel": ("layers", "heads", "embed"),
+                            "bias": ("layers", "norm")},
+                },
+                "ln_2": {"weight": ("layers", "norm"), "bias": ("layers", "norm")},
+                "mlp": {
+                    "fc1": {"kernel": ("layers", "embed", "mlp"),
+                            "bias": ("layers", "mlp")},
+                    "fc2": {"kernel": ("layers", "mlp", "embed"),
+                            "bias": ("layers", "norm")},
+                },
+            },
+            "post_ln": {"weight": ("norm",), "bias": ("norm",)},
+        }
+
+    def patchify(self, pixel_values: jnp.ndarray) -> jnp.ndarray:
+        """[B, H, W, C] -> [B, n_patches, patch*patch*C]."""
+        cfg = self.config
+        B, H, W, C = pixel_values.shape
+        p = cfg.patch_size
+        x = pixel_values.reshape(B, H // p, p, W // p, p, C)
+        x = x.transpose(0, 1, 3, 2, 4, 5)
+        return x.reshape(B, (H // p) * (W // p), p * p * C)
+
+    def _block(self, hidden, p):
+        cfg = self.config
+        B, S, H = hidden.shape
+        nh = cfg.num_attention_heads
+        cd = self.compute_dtype
+        eps = cfg.layer_norm_eps
+
+        x = layer_norm(hidden, p["ln_1"]["weight"], p["ln_1"]["bias"], eps)
+        qkv = x @ p["attn"]["qkv"]["kernel"].astype(cd) + p["attn"]["qkv"]["bias"].astype(cd)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, S, nh, H // nh)
+        attn = dot_product_attention(
+            q.reshape(shape), k.reshape(shape), v.reshape(shape),
+            causal=False).reshape(B, S, H)
+        attn = attn @ p["attn"]["out"]["kernel"].astype(cd) + p["attn"]["out"]["bias"].astype(cd)
+        hidden = hidden + attn
+
+        x = layer_norm(hidden, p["ln_2"]["weight"], p["ln_2"]["bias"], eps)
+        x = jax.nn.gelu(x @ p["mlp"]["fc1"]["kernel"].astype(cd)
+                        + p["mlp"]["fc1"]["bias"].astype(cd), approximate=True)
+        x = x @ p["mlp"]["fc2"]["kernel"].astype(cd) + p["mlp"]["fc2"]["bias"].astype(cd)
+        return hidden + x
+
+    def __call__(self, params, pixel_values: jnp.ndarray) -> jnp.ndarray:
+        """[B, H, W, C] images -> [B, n_patches, hidden] features."""
+        cfg = self.config
+        cd = self.compute_dtype
+        patches = self.patchify(pixel_values).astype(cd)
+        hidden = (patches @ params["patch_embed"]["kernel"].astype(cd)
+                  + params["patch_embed"]["bias"].astype(cd))
+        hidden = hidden + params["pos_embed"]["embedding"].astype(cd)[None]
+
+        def body(h, p):
+            return self._block(h, p), None
+
+        if self.remat:
+            body = jax.checkpoint(body, prevent_cse=False)
+        hidden, _ = lax.scan(body, hidden, params["layers"])
+        return layer_norm(hidden, params["post_ln"]["weight"],
+                          params["post_ln"]["bias"], cfg.layer_norm_eps)
