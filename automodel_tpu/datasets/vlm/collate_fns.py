@@ -1,0 +1,163 @@
+"""VLM collators: conversation samples -> model-ready numpy batches.
+
+Reference parity: ``nemo_automodel/components/datasets/vlm/collate_fns.py:
+30-190`` (``COLLATE_FNS`` registry keyed by processor class name,
+``create_loss_mask_with_start_of_response_token``, qwen/default paths).
+
+TPU-native contract (what ``training/train_step.py`` consumes):
+  * ``input_ids``  [B, S] int32, image placeholders already expanded so each
+    image contributes exactly ``n_patches`` tokens of ``image_token_id``.
+  * ``pixel_values`` [B_img, H, W, C] float32 (NHWC — HF processors emit
+    NCHW, converted here; ``VisionTower.patchify`` is NHWC).
+  * ``labels`` [B, S] int32: next-token shift of ``input_ids`` with -100 on
+    the final position, on pad/image/special tokens, and on everything
+    before the start-of-response marker.  The loss mask is folded into the
+    labels (sum-CE over labels != -100 is the framework-wide convention);
+    ``loss_mask`` is also emitted for reference-schema parity and dropped
+    before the device step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from automodel_tpu.datasets.utils import CROSS_ENTROPY_IGNORE_IDX
+from automodel_tpu.datasets.vlm.utils import extract_skipped_token_ids
+
+
+def _as_numpy(x: Any) -> np.ndarray:
+    """Accept torch tensors / lists from arbitrary HF processors."""
+    if hasattr(x, "detach"):          # torch.Tensor
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def to_nhwc(pixel_values: np.ndarray) -> np.ndarray:
+    """[B, C, H, W] (HF) -> [B, H, W, C]; NHWC passes through."""
+    pv = _as_numpy(pixel_values).astype(np.float32)
+    if pv.ndim == 4 and pv.shape[1] in (1, 3) and pv.shape[-1] not in (1, 3):
+        pv = np.transpose(pv, (0, 2, 3, 1))
+    return pv
+
+
+def find_response_start(input_ids: Sequence[int],
+                        marker_ids: Sequence[int]) -> int:
+    """Index where the response begins (first token AFTER the first
+    occurrence of ``marker_ids``), or 0 when the marker is absent."""
+    n, m = len(input_ids), len(marker_ids)
+    if m == 0:
+        return 0
+    for i in range(n - m + 1):
+        if list(input_ids[i:i + m]) == list(marker_ids):
+            return i + m
+    return 0
+
+
+def create_loss_mask_with_start_of_response_token(
+        input_ids, processor, start_of_response_token=None) -> List[int]:
+    """1 = token contributes to the loss, 0 = masked (prompt / padding).
+
+    Reference ``collate_fns.py:30-77``, re-decomposed: the marker string is
+    tokenized with the processor's tokenizer and everything before (and
+    including) its first occurrence is masked, as are pad positions.
+    """
+    tokenizer = getattr(processor, "tokenizer", processor)
+    ids = [int(t) for t in _as_numpy(input_ids).reshape(-1)]
+    start = 0
+    if isinstance(start_of_response_token, str):
+        marker = tokenizer(
+            start_of_response_token, add_special_tokens=False)["input_ids"]
+        start = find_response_start(ids, marker)
+    pad_id = getattr(tokenizer, "pad_token_id", None)
+    return [0 if (i < start or (pad_id is not None and t == pad_id)) else 1
+            for i, t in enumerate(ids)]
+
+
+def _shifted_masked_labels(input_ids: np.ndarray,
+                           skipped_ids: Sequence[int],
+                           loss_masks: List[List[int]]) -> np.ndarray:
+    """Next-token labels with skipped-token and prompt masking applied.
+
+    ``loss_masks`` is token-aligned (1 = this token is supervised); labels
+    are shifted, so position i predicts token i+1 — the mask must be shifted
+    the same way or the first response token is never supervised."""
+    labels = np.full_like(input_ids, CROSS_ENTROPY_IGNORE_IDX)
+    labels[:, :-1] = input_ids[:, 1:]
+    if len(skipped_ids):
+        labels[np.isin(labels, np.asarray(skipped_ids))] = (
+            CROSS_ENTROPY_IGNORE_IDX)
+    target_masked = np.asarray(loss_masks)[:, 1:] == 0
+    labels[:, :-1][target_masked] = CROSS_ENTROPY_IGNORE_IDX
+    return labels
+
+
+def _gather_images(examples: List[dict]) -> Optional[List[Any]]:
+    """Per-example image lists, from the ``images`` key or from image entries
+    embedded in conversation content."""
+    out: List[Any] = []
+    found = False
+    for ex in examples:
+        imgs = list(ex.get("images") or [])
+        if not imgs:
+            for turn in ex.get("conversation", []):
+                content = turn.get("content")
+                if isinstance(content, list):
+                    imgs.extend(c["image"] for c in content
+                                if isinstance(c, dict) and "image" in c)
+        found = found or bool(imgs)
+        out.append(imgs)
+    return out if found else None
+
+
+def _collate(examples: List[dict], processor,
+             start_of_response_token: Optional[str],
+             max_length: Optional[int] = None) -> Dict[str, np.ndarray]:
+    texts = [processor.apply_chat_template(ex["conversation"], tokenize=False)
+             for ex in examples]
+    kwargs: Dict[str, Any] = dict(padding=True, return_tensors="np")
+    if max_length is not None:
+        kwargs.update(truncation=True, max_length=max_length)
+    images = _gather_images(examples)
+    if images is not None:
+        kwargs["images"] = images
+    batch = processor(text=texts, **kwargs)
+
+    out: Dict[str, np.ndarray] = {
+        "input_ids": _as_numpy(batch["input_ids"]).astype(np.int32)}
+    if batch.get("pixel_values") is not None:
+        out["pixel_values"] = to_nhwc(batch["pixel_values"])
+
+    loss_masks = [
+        create_loss_mask_with_start_of_response_token(
+            row, processor, start_of_response_token)
+        for row in out["input_ids"]
+    ]
+    skipped = extract_skipped_token_ids(processor)
+    out["labels"] = _shifted_masked_labels(
+        out["input_ids"], skipped, loss_masks)
+    out["loss_mask"] = np.asarray(loss_masks, np.float32)
+    return out
+
+
+def qwen2_5_collate_fn(examples: List[dict], processor,
+                       start_of_response_token: str = "<|im_start|>assistant\n"
+                       ) -> Dict[str, np.ndarray]:
+    """Qwen2.5-VL: im_start/assistant response marker (reference
+    ``collate_fns.py:120-148``)."""
+    return _collate(examples, processor, start_of_response_token)
+
+
+def default_collate_fn(examples: List[dict], processor,
+                       start_of_response_token: Optional[str] = None
+                       ) -> Dict[str, np.ndarray]:
+    """Gemma3-style default path (reference ``collate_fns.py:151-184``)."""
+    return _collate(examples, processor, start_of_response_token)
+
+
+# Processor class name -> collate fn (reference ``collate_fns.py:187-190``).
+COLLATE_FNS = {
+    "Qwen2_5_VLProcessor": qwen2_5_collate_fn,
+    "default": default_collate_fn,
+}

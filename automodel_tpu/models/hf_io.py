@@ -40,12 +40,22 @@ class HfSpec:
 
     ``template`` contains ``{i}`` when the param is a stack over layers.
     ``transpose``: HF stores torch Linear as (out, in); our kernel is (in, out).
+    ``load_transform``/``save_transform``: arbitrary layout changes (e.g. a
+    conv patch-embed kernel (out, C, p, p) <-> our patch matmul (p*p*C, out)).
+    A transform defeats byte-range slicing, so the full HF tensor is read and
+    transformed before the requested slice is taken — only use it for params
+    small enough to materialize on every host.
     """
 
-    def __init__(self, template: str, stacked: bool = False, transpose: bool = False):
+    def __init__(self, template: str, stacked: bool = False,
+                 transpose: bool = False,
+                 load_transform: Optional[Callable] = None,
+                 save_transform: Optional[Callable] = None):
         self.template = template
         self.stacked = stacked
         self.transpose = transpose
+        self.load_transform = load_transform
+        self.save_transform = save_transform
 
 
 def llama_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
@@ -94,6 +104,70 @@ def gpt2_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
                      ("mlp", "c_fc"), ("mlp", "c_proj")):
         m[("h", mod, sub, "kernel")] = HfSpec(f"h.{{i}}.{mod}.{sub}.weight", stacked=True)
         m[("h", mod, sub, "bias")] = HfSpec(f"h.{{i}}.{mod}.{sub}.bias", stacked=True)
+    return m
+
+
+def vision_key_map(config, prefix: str = "vision_tower.vision_model."
+                   ) -> Dict[Tuple[str, ...], HfSpec]:
+    """SigLIP-family vision tower (HF ``SiglipVisionModel`` naming, the tower
+    Gemma3/PaliGemma VLMs carry; reference loads it through
+    ``NeMoAutoModelForImageTextToText``, ``_transformers/auto_model.py:415``)."""
+    p, C, H = config.patch_size, config.num_channels, config.hidden_size
+
+    def conv_to_matmul(w: np.ndarray) -> np.ndarray:
+        # (H_out, C, p, p) conv kernel -> (p*p*C, H_out) patch matmul, patch
+        # vector laid out (row, col, channel) to match VisionTower.patchify.
+        return np.ascontiguousarray(
+            w.transpose(2, 3, 1, 0).reshape(p * p * C, w.shape[0]))
+
+    def matmul_to_conv(w: np.ndarray) -> np.ndarray:
+        return np.ascontiguousarray(
+            w.reshape(p, p, C, w.shape[-1]).transpose(3, 2, 0, 1))
+
+    m: Dict[Tuple[str, ...], HfSpec] = {
+        ("patch_embed", "kernel"): HfSpec(
+            prefix + "embeddings.patch_embedding.weight",
+            load_transform=conv_to_matmul, save_transform=matmul_to_conv),
+        ("patch_embed", "bias"): HfSpec(
+            prefix + "embeddings.patch_embedding.bias"),
+        ("pos_embed", "embedding"): HfSpec(
+            prefix + "embeddings.position_embedding.weight"),
+        ("post_ln", "weight"): HfSpec(prefix + "post_layernorm.weight"),
+        ("post_ln", "bias"): HfSpec(prefix + "post_layernorm.bias"),
+    }
+    layer = prefix + "encoder.layers.{i}."
+    for ours, hf in (("ln_1", "layer_norm1"), ("ln_2", "layer_norm2")):
+        for wb in ("weight", "bias"):
+            m[("layers", ours, wb)] = HfSpec(
+                layer + f"{hf}.{wb}", stacked=True)
+    for proj in ("q_proj", "k_proj", "v_proj", "out_proj"):
+        m[("layers", "attn", proj, "kernel")] = HfSpec(
+            layer + f"self_attn.{proj}.weight", stacked=True, transpose=True)
+        m[("layers", "attn", proj, "bias")] = HfSpec(
+            layer + f"self_attn.{proj}.bias", stacked=True)
+    for fc in ("fc1", "fc2"):
+        m[("layers", "mlp", fc, "kernel")] = HfSpec(
+            layer + f"mlp.{fc}.weight", stacked=True, transpose=True)
+        m[("layers", "mlp", fc, "bias")] = HfSpec(
+            layer + f"mlp.{fc}.bias", stacked=True)
+    return m
+
+
+def vlm_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    """Image-text-to-text model (llava-style HF naming: ``language_model.*``,
+    ``vision_tower.vision_model.*``, ``multi_modal_projector.linear_{1,2}``)."""
+    m: Dict[Tuple[str, ...], HfSpec] = {}
+    for path, spec in llama_key_map(config.text_config).items():
+        m[("language_model",) + path] = HfSpec(
+            "language_model." + spec.template, stacked=spec.stacked,
+            transpose=spec.transpose)
+    for path, spec in vision_key_map(config.vision_config).items():
+        m[("vision_tower",) + path] = spec
+    for ours, hf in (("fc1", "linear_1"), ("fc2", "linear_2")):
+        m[("multi_modal_projector", ours, "kernel")] = HfSpec(
+            f"multi_modal_projector.{hf}.weight", transpose=True)
+        m[("multi_modal_projector", ours, "bias")] = HfSpec(
+            f"multi_modal_projector.{hf}.bias")
     return m
 
 
@@ -147,7 +221,9 @@ class _LazyCheckpoint:
 def _hf_slice(spec: HfSpec, layer: Optional[int], idx: Tuple[slice, ...],
               ckpt: _LazyCheckpoint, dtype) -> np.ndarray:
     key = spec.template.format(i=layer) if spec.stacked else spec.template
-    if spec.transpose:
+    if spec.load_transform is not None:
+        arr = spec.load_transform(ckpt.get(key))[idx]
+    elif spec.transpose:
         # requested (in, out) slice -> read (out, in) then transpose
         hf_idx = (idx[1], idx[0]) if len(idx) == 2 else idx[::-1]
         arr = ckpt.get_slice(key, hf_idx).T
@@ -244,19 +320,24 @@ def save_hf_weights(
             raise KeyError(f"No HF mapping for param {'/'.join(path)}")
         itemsize = (save_dtype or np.dtype(str(value.dtype))).itemsize
 
+        def to_hf(arr: np.ndarray, spec: HfSpec) -> np.ndarray:
+            if spec.save_transform is not None:
+                arr = spec.save_transform(arr)
+            elif spec.transpose:
+                arr = arr.T
+            # safetensors serializes the raw buffer, ignoring strides: a
+            # transposed *view* would save the untransposed data.
+            return np.ascontiguousarray(arr)
+
         if spec.stacked:
             per_layer = int(np.prod(value.shape[1:])) * itemsize
             for i in range(value.shape[0]):
                 def layer_fn(v=value, i=i, spec=spec):
-                    arr = materialize(v[i])
-                    # safetensors serializes the raw buffer, ignoring strides:
-                    # a transposed *view* would save the untransposed data.
-                    return np.ascontiguousarray(arr.T) if spec.transpose else arr
+                    return to_hf(materialize(v[i]), spec)
                 entries.append((spec.template.format(i=i), per_layer, layer_fn))
         else:
             def full_fn(v=value, spec=spec):
-                arr = materialize(v)
-                return np.ascontiguousarray(arr.T) if spec.transpose else arr
+                return to_hf(materialize(v), spec)
             entries.append(
                 (spec.template, int(np.prod(value.shape)) * itemsize, full_fn))
 

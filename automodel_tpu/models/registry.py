@@ -66,3 +66,8 @@ def _ensure_builtin() -> None:
                                    hf_io.llama_key_map, [arch]))
     register_model(ModelFamily("gpt2", GPT2Config, GPT2LMHeadModel,
                                hf_io.gpt2_key_map, ["GPT2LMHeadModel"]))
+    from automodel_tpu.models.vlm import VLMConfig, VLMForConditionalGeneration
+
+    register_model(ModelFamily("llava", VLMConfig, VLMForConditionalGeneration,
+                               hf_io.vlm_key_map,
+                               ["LlavaForConditionalGeneration"]))

@@ -75,11 +75,18 @@ class VisionTower:
                                          layers=False)},
             "layers": {
                 "ln_1": {"weight": ones((H,)), "bias": zeros((H,))},
+                # Separate q/k/v/out projections — 1:1 with HF SigLIP keys
+                # (vision_model.encoder.layers.{i}.self_attn.{q,k,v,out}_proj)
+                # so pretrained towers stream-load without key surgery.
                 "attn": {
-                    "qkv": {"kernel": w(next(ks), (H, 3 * H)),
-                            "bias": zeros((3 * H,))},
-                    "out": {"kernel": w(next(ks), (H, H)),
-                            "bias": zeros((H,))},
+                    "q_proj": {"kernel": w(next(ks), (H, H)),
+                               "bias": zeros((H,))},
+                    "k_proj": {"kernel": w(next(ks), (H, H)),
+                               "bias": zeros((H,))},
+                    "v_proj": {"kernel": w(next(ks), (H, H)),
+                               "bias": zeros((H,))},
+                    "out_proj": {"kernel": w(next(ks), (H, H)),
+                                 "bias": zeros((H,))},
                 },
                 "ln_2": {"weight": ones((H,)), "bias": zeros((H,))},
                 "mlp": {
@@ -101,10 +108,14 @@ class VisionTower:
             "layers": {
                 "ln_1": {"weight": ("layers", "norm"), "bias": ("layers", "norm")},
                 "attn": {
-                    "qkv": {"kernel": ("layers", "embed", "qkv3"),
-                            "bias": ("layers", "qkv3")},
-                    "out": {"kernel": ("layers", "heads", "embed"),
-                            "bias": ("layers", "norm")},
+                    "q_proj": {"kernel": ("layers", "embed", "heads"),
+                               "bias": ("layers", "heads")},
+                    "k_proj": {"kernel": ("layers", "embed", "heads"),
+                               "bias": ("layers", "heads")},
+                    "v_proj": {"kernel": ("layers", "embed", "heads"),
+                               "bias": ("layers", "heads")},
+                    "out_proj": {"kernel": ("layers", "heads", "embed"),
+                                 "bias": ("layers", "norm")},
                 },
                 "ln_2": {"weight": ("layers", "norm"), "bias": ("layers", "norm")},
                 "mlp": {
@@ -134,13 +145,16 @@ class VisionTower:
         eps = cfg.layer_norm_eps
 
         x = layer_norm(hidden, p["ln_1"]["weight"], p["ln_1"]["bias"], eps)
-        qkv = x @ p["attn"]["qkv"]["kernel"].astype(cd) + p["attn"]["qkv"]["bias"].astype(cd)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        a = p["attn"]
+        q = x @ a["q_proj"]["kernel"].astype(cd) + a["q_proj"]["bias"].astype(cd)
+        k = x @ a["k_proj"]["kernel"].astype(cd) + a["k_proj"]["bias"].astype(cd)
+        v = x @ a["v_proj"]["kernel"].astype(cd) + a["v_proj"]["bias"].astype(cd)
         shape = (B, S, nh, H // nh)
         attn = dot_product_attention(
             q.reshape(shape), k.reshape(shape), v.reshape(shape),
             causal=False).reshape(B, S, H)
-        attn = attn @ p["attn"]["out"]["kernel"].astype(cd) + p["attn"]["out"]["bias"].astype(cd)
+        attn = (attn @ a["out_proj"]["kernel"].astype(cd)
+                + a["out_proj"]["bias"].astype(cd))
         hidden = hidden + attn
 
         x = layer_norm(hidden, p["ln_2"]["weight"], p["ln_2"]["bias"], eps)

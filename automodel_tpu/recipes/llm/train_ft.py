@@ -232,6 +232,13 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             self.plan = build_parallel_plan(self.model, self.mesh_manager)
             self.param_sharding = self.plan.param_sharding
 
+        # Parameter freezing (optax mask; reference applies requires_grad
+        # freezing before optimizer construction, ``vlm/finetune.py:70-89``)
+        freeze_mask = self._build_freeze_mask()
+        if freeze_mask is not None:
+            mask = freeze_mask if mask is None else jax.tree.map(
+                lambda a, b: bool(a) and bool(b), mask, freeze_mask)
+
         # Optimizer
         opt_cfg = cfg.get("optimizer")
         opt_kwargs = {k: v for k, v in (opt_cfg.to_dict() if opt_cfg else {}).items()
@@ -275,24 +282,13 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         self.opt_state = self.step_fns.init_opt_state(self.params)
 
         # Data
-        self.tokenizer = build_tokenizer(cfg, self.model)
         ss_cfg = cfg.get("step_scheduler")
         local_bs = int(ss_cfg.get("local_batch_size", 1)) if ss_cfg else 1
         # The loader yields GLOBAL microbatches (see datasets/dataloader.py):
         # reference local_batch_size is per-dp-rank, so the global microbatch
         # is local_bs x dp_size.
         global_mb = local_bs * self.mesh_manager.dp_size
-        dataset = build_dataset(cfg.get("dataset"), tokenizer=self.tokenizer)
-        self.dataloader = build_dataloader(
-            cfg, dataset, "dataloader",
-            local_batch_size=global_mb, seed=self.rng.seed)
-        self.val_dataloader = None
-        if cfg.get("validation_dataset") is not None:
-            val_ds = build_dataset(cfg.get("validation_dataset"),
-                                   tokenizer=self.tokenizer)
-            self.val_dataloader = build_dataloader(
-                cfg, val_ds, "validation_dataloader",
-                local_batch_size=global_mb, seed=self.rng.seed)
+        self._setup_data(global_mb)
 
         # Schedules
         ss_kwargs = ss_cfg.to_dict() if ss_cfg is not None else {}
@@ -314,14 +310,38 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         self.load_checkpoint()
         return self
 
+    # -- overridable setup hooks (the VLM recipe swaps these) ---------------
+    def _build_freeze_mask(self):
+        """Optax trainable-mask (True = trainable) from ``freeze_config``
+        YAML, or None when nothing is frozen."""
+        freeze_cfg = self.cfg.get("freeze_config")
+        if freeze_cfg is None:
+            return None
+        from automodel_tpu.utils.model_utils import apply_parameter_freezing
+
+        return apply_parameter_freezing(
+            self.model.abstract_params(), freeze_cfg)
+
+    def _setup_data(self, global_mb: int) -> None:
+        cfg = self.cfg
+        self.tokenizer = build_tokenizer(cfg, self.model)
+        dataset = build_dataset(cfg.get("dataset"), tokenizer=self.tokenizer)
+        self.dataloader = build_dataloader(
+            cfg, dataset, "dataloader",
+            local_batch_size=global_mb, seed=self.rng.seed)
+        self.val_dataloader = None
+        if cfg.get("validation_dataset") is not None:
+            val_ds = build_dataset(cfg.get("validation_dataset"),
+                                   tokenizer=self.tokenizer)
+            self.val_dataloader = build_dataloader(
+                cfg, val_ds, "validation_dataloader",
+                local_batch_size=global_mb, seed=self.rng.seed)
+
     # -- hot loop ----------------------------------------------------------
     def _device_batch(self, batches: List[Dict[str, np.ndarray]]):
         stacked = stack_microbatches(batches)
         stacked.pop("loss_mask", None)  # already folded into labels
-        sharding = self.step_fns.microbatch_sharding
-        if sharding is not None:
-            return jax.device_put(stacked, sharding)
-        return stacked
+        return self.step_fns.shard_batch(stacked)
 
     def _run_train_optim_step(self, batches: List[Dict[str, np.ndarray]]):
         num_tokens, _ = count_tokens(batches)

@@ -1,0 +1,136 @@
+"""The VLM (image-text-to-text) fine-tuning trainer.
+
+Reference parity: ``nemo_automodel/recipes/vlm/finetune.py:70-846``
+(``FinetuneRecipeForVLM``) — same YAML schema as the LLM recipe plus
+``processor``, ``freeze_config`` and a ``dataloader.collate_fn`` node
+dispatched through ``COLLATE_FNS`` by processor class.
+
+TPU-native shape: the whole trainer is the LLM recipe
+(``recipes/llm/train_ft.py``) with two hooks swapped — the data path builds
+an AutoProcessor + VLM collator instead of a tokenizer, and the default
+freeze policy masks embeddings/vision tower via the optax trainable-mask
+instead of ``requires_grad`` surgery.  The jitted train step is shared; VLM
+batches simply carry ``pixel_values`` which the step shards over dp.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Any, Callable, Dict, Optional
+
+from automodel_tpu.config.arg_parser import parse_args_and_load_config
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.datasets.dataloader import StatefulDataLoader
+from automodel_tpu.datasets.vlm.collate_fns import COLLATE_FNS
+from automodel_tpu.recipes.llm.train_ft import (
+    TrainFinetuneRecipeForNextTokenPrediction,
+    build_dataset,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def build_processor(cfg: ConfigNode, model) -> Any:
+    """Processor from ``processor._target_`` YAML, or AutoProcessor from the
+    model's checkpoint dir (reference ``vlm/finetune.py:249-`` build order)."""
+    proc_cfg = cfg.get("processor")
+    if isinstance(proc_cfg, ConfigNode) and "_target_" in proc_cfg:
+        return proc_cfg.instantiate()
+    kwargs = proc_cfg.to_dict() if isinstance(proc_cfg, ConfigNode) else {}
+    ckpt_dir = getattr(model, "checkpoint_dir", None)
+    if ckpt_dir is not None:
+        try:
+            from transformers import AutoProcessor
+
+            return AutoProcessor.from_pretrained(ckpt_dir, **kwargs)
+        except Exception as e:
+            logger.warning("AutoProcessor unavailable for %s (%s)",
+                           ckpt_dir, e)
+    raise ValueError(
+        "VLM fine-tuning needs a processor: set `processor._target_` in the "
+        "config (e.g. automodel_tpu.datasets.vlm.mock.MockVLMProcessor for "
+        "offline runs) or point `model` at a checkpoint with processor files")
+
+
+def select_collate_fn(dl_cfg: Optional[ConfigNode], processor) -> Callable:
+    """Resolve the collator: an explicit ``dataloader.collate_fn`` node wins;
+    otherwise dispatch on the processor class name through ``COLLATE_FNS``
+    (reference ``vlm/finetune.py`` collate wiring +
+    ``datasets/vlm/collate_fns.py:187-190``)."""
+    node = dl_cfg.get("collate_fn") if isinstance(dl_cfg, ConfigNode) else None
+    if isinstance(node, ConfigNode) and "_target_" in node:
+        return lambda examples: node.instantiate(
+            examples=examples, processor=processor)
+    if callable(node):
+        return functools.partial(node, processor=processor)
+    name = type(processor).__name__
+    if name not in COLLATE_FNS:
+        logger.warning("No dedicated collate_fn for %s; using default", name)
+        name = "default"
+    return functools.partial(COLLATE_FNS[name], processor=processor)
+
+
+def build_vlm_dataloader(cfg: ConfigNode, dataset, processor,
+                         cfg_key: str, batch_size: int, seed: int):
+    dl_cfg = cfg.get(cfg_key)
+    kwargs: Dict[str, Any] = {}
+    if isinstance(dl_cfg, ConfigNode):
+        kwargs = {k: v for k, v in dl_cfg.to_dict().items()
+                  if k not in ("_target_", "collate_fn")}
+    kwargs.setdefault("batch_size", batch_size)
+    kwargs.setdefault("seed", seed)
+    cls = StatefulDataLoader
+    target = dl_cfg.get("_target_") if isinstance(dl_cfg, ConfigNode) else None
+    if target:
+        from automodel_tpu.config.loader import resolve_target
+
+        cls = resolve_target(target)
+    return cls(dataset, collate_fn=select_collate_fn(dl_cfg, processor),
+               **kwargs)
+
+
+class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
+    """``setup()`` then ``run_train_validation_loop()`` (reference
+    ``vlm/finetune.py:496``)."""
+
+    def _build_freeze_mask(self):
+        """``freeze_config`` YAML, defaulting to frozen embeddings when the
+        section is absent (reference ``_freeze_model``,
+        ``vlm/finetune.py:70-89``)."""
+        from automodel_tpu.utils.model_utils import apply_parameter_freezing
+
+        freeze_cfg = self.cfg.get("freeze_config")
+        if freeze_cfg is None:
+            freeze_cfg = {"freeze_embeddings": True}
+        return apply_parameter_freezing(
+            self.model.abstract_params(), freeze_cfg)
+
+    def _setup_data(self, global_mb: int) -> None:
+        cfg = self.cfg
+        self.processor = build_processor(cfg, self.model)
+        self.tokenizer = getattr(self.processor, "tokenizer", None)
+        dataset = build_dataset(cfg.get("dataset"))
+        self.dataloader = build_vlm_dataloader(
+            cfg, dataset, self.processor, "dataloader",
+            batch_size=global_mb, seed=self.rng.seed)
+        self.val_dataloader = None
+        if cfg.get("validation_dataset") is not None:
+            val_ds = build_dataset(cfg.get("validation_dataset"))
+            self.val_dataloader = build_vlm_dataloader(
+                cfg, val_ds, self.processor, "validation_dataloader",
+                batch_size=global_mb, seed=self.rng.seed)
+
+
+def main(config_path: Optional[str] = None, argv=None):
+    """CLI entry (reference ``vlm/finetune.py:832-846``)."""
+    logging.basicConfig(level=logging.INFO)
+    cfg = parse_args_and_load_config(argv, default_config=config_path)
+    recipe = FinetuneRecipeForVLM(cfg)
+    recipe.setup()
+    recipe.run_train_validation_loop()
+    return recipe
+
+
+if __name__ == "__main__":
+    main()

@@ -37,7 +37,8 @@ from automodel_tpu.distributed.shardings import (
 from automodel_tpu.loss.masked_ce import IGNORE_INDEX, MaskedCrossEntropy
 
 # Keys the model forward consumes; anything else in a batch is ignored.
-_MODEL_KEYS = ("input_ids", "position_ids", "segment_ids", "attention_mask")
+_MODEL_KEYS = ("input_ids", "position_ids", "segment_ids", "attention_mask",
+               "pixel_values")
 
 
 def _microbatch_loss(model, loss_fn, params, mb: Dict[str, jnp.ndarray]):
@@ -62,6 +63,40 @@ class TrainStepFns:
     init_opt_state: Callable
     opt_state_sharding: Any
     microbatch_sharding: Any
+
+    def shard_batch(self, stacked: Dict[str, Any]) -> Dict[str, Any]:
+        """Place a stacked microbatch dict on the mesh with per-key specs:
+        [A, B, S] token arrays get the dp x cp batch sharding; pixel_values
+        [A, B_img, H, W, C] shard the image-batch dim over dp only (images
+        have no sequence dim to context-parallelize); anything else is
+        replicated."""
+        if self.microbatch_sharding is None:
+            return stacked
+        mesh = self.microbatch_sharding.mesh
+        spec = self.microbatch_sharding.spec  # P(None, dp_axes, cp_axes)
+        pixel_sharding = NamedSharding(mesh, P(*spec[:2]))
+        rep = NamedSharding(mesh, P())
+
+        def axis_size(spec_entry) -> int:
+            axes = (spec_entry,) if isinstance(spec_entry, str) else (
+                spec_entry or ())
+            size = 1
+            for a in axes:
+                size *= mesh.shape[a]
+            return size
+
+        def place(key, v):
+            if key == "pixel_values":
+                # Image counts are data-dependent (multi-image conversations);
+                # fall back to replication when the dp split doesn't divide.
+                if v.shape[1] % axis_size(spec[1]) == 0:
+                    return jax.device_put(v, pixel_sharding)
+                return jax.device_put(v, rep)
+            if getattr(v, "ndim", 0) == 3:
+                return jax.device_put(v, self.microbatch_sharding)
+            return jax.device_put(v, rep)
+
+        return {k: place(k, v) for k, v in stacked.items()}
 
 
 def build_train_step(
@@ -149,15 +184,18 @@ def build_train_step(
             mesh, P(None, *plan.batch_sharding.spec))
         rep = NamedSharding(mesh, P())
 
+        # The batch entry is None (inferred from the committed arrays) —
+        # keys and ranks vary per recipe (VLM adds pixel_values), so a fixed
+        # sharding pytree cannot cover it; ``shard_batch`` commits each leaf.
         train_jit = jax.jit(
             train_step,
-            in_shardings=(plan.param_sharding, opt_sharding, mb_sharding),
+            in_shardings=(plan.param_sharding, opt_sharding, None),
             out_shardings=(plan.param_sharding, opt_sharding, rep),
             donate_argnums=(0, 1),
         )
         eval_jit = jax.jit(
             eval_step,
-            in_shardings=(plan.param_sharding, mb_sharding),
+            in_shardings=(plan.param_sharding, None),
             out_shardings=rep,
         )
         init_opt = jax.jit(tx.init, out_shardings=opt_sharding)
@@ -193,13 +231,24 @@ def stack_microbatches(microbatches) -> Dict[str, jnp.ndarray]:
     out = {}
     for k in sorted(keys):
         arrs = [np.asarray(mb[k]) for mb in microbatches]
-        max_s = max(a.shape[-1] for a in arrs)
-        if any(a.shape[-1] != max_s for a in arrs):
-            pad_val = get_pad_token_from_key(k) or 0
+        if k == "pixel_values":
+            # Image counts vary per microbatch; pad with zero-images at the
+            # END of the flat image list — the placeholder scatter consumes
+            # images in order, so trailing pads are never referenced.
+            max_imgs = max(a.shape[0] for a in arrs)
             arrs = [
-                np.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, max_s - a.shape[-1])],
-                       constant_values=pad_val)
+                np.pad(a, [(0, max_imgs - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
                 for a in arrs
             ]
+        else:
+            max_s = max(a.shape[-1] for a in arrs)
+            if any(a.shape[-1] != max_s for a in arrs):
+                pad_val = get_pad_token_from_key(k) or 0
+                arrs = [
+                    np.pad(a,
+                           [(0, 0)] * (a.ndim - 1) + [(0, max_s - a.shape[-1])],
+                           constant_values=pad_val)
+                    for a in arrs
+                ]
         out[k] = np.stack(arrs, axis=0)
     return out

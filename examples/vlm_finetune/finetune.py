@@ -1,0 +1,13 @@
+"""20-line shim calling the VLM recipe main (reference
+``examples/vlm_finetune/finetune.py``)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+from automodel_tpu.recipes.vlm.finetune import main  # noqa: E402
+
+if __name__ == "__main__":
+    main()

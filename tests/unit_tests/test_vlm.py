@@ -1,0 +1,177 @@
+"""VLM components: collators, mock processor, registry, HF round-trip.
+
+Mirrors the reference's ``tests/unit_tests/datasets/vlm`` coverage
+(collate label masking, skipped-token ids) plus the HF weight round-trip
+the TPU build adds for the llava-style family.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.datasets.utils import CROSS_ENTROPY_IGNORE_IDX
+from automodel_tpu.datasets.vlm.collate_fns import (
+    COLLATE_FNS,
+    default_collate_fn,
+    find_response_start,
+    to_nhwc,
+)
+from automodel_tpu.datasets.vlm.mock import (
+    RESPONSE_MARKER,
+    MockVLMProcessor,
+    make_mock_vlm_dataset,
+)
+from automodel_tpu.models.vlm import VLMConfig, VLMForConditionalGeneration
+
+
+@pytest.fixture(scope="module")
+def processor():
+    return MockVLMProcessor(vocab_size=512, image_size=32, patch_size=16,
+                            image_token_id=7)
+
+
+@pytest.fixture(scope="module")
+def samples():
+    return make_mock_vlm_dataset(num_samples=4, image_size=32, seed=0)
+
+
+def tiny_vlm():
+    cfg = VLMConfig(
+        text_config={"model_type": "llama", "vocab_size": 512,
+                     "hidden_size": 64, "intermediate_size": 128,
+                     "num_hidden_layers": 2, "num_attention_heads": 4,
+                     "num_key_value_heads": 2,
+                     "tie_word_embeddings": True},
+        vision_config={"hidden_size": 48, "intermediate_size": 96,
+                       "num_hidden_layers": 2, "num_attention_heads": 4,
+                       "image_size": 32, "patch_size": 16},
+        image_token_id=7)
+    return VLMForConditionalGeneration(cfg)
+
+
+# -- collators ---------------------------------------------------------------
+def test_default_collate_shapes_and_masking(processor, samples):
+    batch = default_collate_fn(samples, processor,
+                               start_of_response_token=RESPONSE_MARKER)
+    ids, labels, pv = batch["input_ids"], batch["labels"], batch["pixel_values"]
+    B, S = ids.shape
+    assert labels.shape == (B, S)
+    # NHWC float pixel batch, one image per sample
+    assert pv.shape == (B, 32, 32, 3) and pv.dtype == np.float32
+    # every image contributes exactly n_patches placeholder tokens
+    assert (ids == 7).sum() == B * processor.num_patches
+    # image-token positions never contribute to the loss
+    assert not (labels == 7).any()
+    # prompt (before the response marker) is fully masked, and the FIRST
+    # response token is supervised (the mask shifts with the labels)
+    marker = processor.tokenizer(RESPONSE_MARKER)["input_ids"]
+    for b in range(B):
+        start = find_response_start(list(ids[b]), marker)
+        assert start > 0
+        assert np.all(labels[b, :start - 1] == CROSS_ENTROPY_IGNORE_IDX)
+        assert labels[b, start - 1] == ids[b, start]
+        # response region has live labels
+        assert (labels[b, start:] != CROSS_ENTROPY_IGNORE_IDX).sum() > 0
+    # labels are the next-token shift wherever they are live
+    live = labels != CROSS_ENTROPY_IGNORE_IDX
+    shifted = np.full_like(ids, CROSS_ENTROPY_IGNORE_IDX)
+    shifted[:, :-1] = ids[:, 1:]
+    assert np.array_equal(labels[live], shifted[live])
+
+
+def test_collate_registry_dispatch(processor, samples):
+    assert "default" in COLLATE_FNS and "Qwen2_5_VLProcessor" in COLLATE_FNS
+    out = COLLATE_FNS["default"](samples, processor)
+    assert out["input_ids"].dtype == np.int32
+
+
+def test_to_nhwc_conversion():
+    nchw = np.zeros((2, 3, 8, 8), np.float32)
+    assert to_nhwc(nchw).shape == (2, 8, 8, 3)
+    nhwc = np.zeros((2, 8, 8, 3), np.float32)
+    assert to_nhwc(nhwc).shape == (2, 8, 8, 3)
+
+
+def test_find_response_start():
+    assert find_response_start([1, 2, 3, 4], [3]) == 3
+    assert find_response_start([1, 2, 3, 4], [2, 3]) == 3
+    assert find_response_start([1, 2], [9]) == 0
+    assert find_response_start([1, 2], []) == 0
+
+
+# -- model + registry --------------------------------------------------------
+def test_registry_builds_llava():
+    from automodel_tpu.models.auto_model import build_model
+
+    model = build_model(config={
+        "model_type": "llava", "image_token_id": 7,
+        "text_config": {"model_type": "llama", "vocab_size": 512,
+                        "hidden_size": 64, "intermediate_size": 128,
+                        "num_hidden_layers": 2, "num_attention_heads": 4,
+                        "num_key_value_heads": 2},
+        "vision_config": {"hidden_size": 48, "intermediate_size": 96,
+                          "num_hidden_layers": 2, "num_attention_heads": 4,
+                          "image_size": 32, "patch_size": 16}})
+    assert isinstance(model, VLMForConditionalGeneration)
+    assert model.config.image_token_id == 7
+
+
+def test_vlm_logits_depend_on_image(processor, samples):
+    model = tiny_vlm()
+    params = model.init(jax.random.key(0))
+    batch = default_collate_fn(samples[:1], processor, None)
+    ids = jnp.asarray(batch["input_ids"], jnp.int32)
+    pv = jnp.asarray(batch["pixel_values"])
+    out1 = model(params, ids, pixel_values=pv)["logits"]
+    out2 = model(params, ids, pixel_values=pv + 1.0)["logits"]
+    assert not np.allclose(np.asarray(out1), np.asarray(out2))
+    # without live image tokens the text path is pure llama
+    text_ids = jnp.where(ids == 7, 1, ids)
+    o_text = model(params, text_ids)["logits"]
+    assert np.all(np.isfinite(np.asarray(o_text)))
+
+
+def test_stack_microbatches_pads_variable_image_counts():
+    from automodel_tpu.training.train_step import stack_microbatches
+
+    mb1 = {"input_ids": np.zeros((2, 8), np.int32),
+           "labels": np.zeros((2, 8), np.int32),
+           "pixel_values": np.ones((3, 4, 4, 3), np.float32)}
+    mb2 = {"input_ids": np.zeros((2, 8), np.int32),
+           "labels": np.zeros((2, 8), np.int32),
+           "pixel_values": np.ones((1, 4, 4, 3), np.float32)}
+    stacked = stack_microbatches([mb1, mb2])
+    assert stacked["pixel_values"].shape == (2, 3, 4, 4, 3)
+    # trailing zero-image padding, real images untouched
+    assert np.all(stacked["pixel_values"][1, 1:] == 0)
+    assert np.all(stacked["pixel_values"][1, 0] == 1)
+
+
+def test_vlm_hf_roundtrip(tmp_path):
+    from automodel_tpu.models.auto_model import AutoModelForCausalLM
+    from automodel_tpu.models.hf_io import load_hf_weights, save_hf_weights
+
+    model = tiny_vlm()
+    params = model.init(jax.random.key(1))
+    save_hf_weights(model, params, str(tmp_path))
+
+    # llava-style HF naming on disk
+    import json
+    import os
+
+    with open(os.path.join(tmp_path, "model.safetensors.index.json")) as f:
+        keys = set(json.load(f)["weight_map"])
+    assert "language_model.model.embed_tokens.weight" in keys
+    assert ("vision_tower.vision_model.encoder.layers.0.self_attn."
+            "q_proj.weight") in keys
+    assert "multi_modal_projector.linear_1.weight" in keys
+    assert "vision_tower.vision_model.embeddings.patch_embedding.weight" in keys
+
+    model2 = AutoModelForCausalLM.from_pretrained(str(tmp_path))
+    assert isinstance(model2, VLMForConditionalGeneration)
+    params2 = load_hf_weights(model2, str(tmp_path))
+    diffs = jax.tree.map(
+        lambda a, b: float(np.max(np.abs(np.asarray(a) - np.asarray(b)))),
+        params, params2)
+    assert max(jax.tree.leaves(diffs)) == 0.0
