@@ -18,6 +18,7 @@ import importlib
 import importlib.util
 import os
 import sys
+import logging
 from typing import Any, Iterator, Optional
 
 import yaml
@@ -25,6 +26,78 @@ import yaml
 _TARGET_KEY = "_target_"
 # A sentinel distinct from None (YAML null is a legitimate value).
 _UNSET = object()
+
+logger = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------------
+# Reference-YAML compatibility: the reference's example configs point
+# ``_target_`` at ``nemo_automodel.*`` / ``torchdata.*`` paths.  Rather than
+# force users to rewrite every YAML, translate those dotted paths to the
+# TPU-native equivalents at resolution time (exact names first, then prefix
+# rewrites).  This makes e.g.
+# ``/root/reference/examples/llm_finetune/llama3_2/llama3_2_1b_hellaswag.yaml``
+# run byte-unchanged.
+_TARGET_ALIASES = {
+    # Facade classes live at the package root in the reference.
+    "nemo_automodel.NeMoAutoModelForCausalLM":
+        "automodel_tpu.models.auto_model.AutoModelForCausalLM",
+    "nemo_automodel.NeMoAutoModelForImageTextToText":
+        "automodel_tpu.models.auto_model.AutoModelForImageTextToText",
+    "nemo_automodel.components._transformers.auto_model.NeMoAutoModelForCausalLM":
+        "automodel_tpu.models.auto_model.AutoModelForCausalLM",
+    "nemo_automodel.components._transformers.auto_model.NeMoAutoModelForImageTextToText":
+        "automodel_tpu.models.auto_model.AutoModelForImageTextToText",
+    # Every torch parallelism manager maps onto the one GSPMD mesh manager.
+    "nemo_automodel.components.distributed.fsdp2.FSDP2Manager":
+        "automodel_tpu.distributed.mesh.MeshManager",
+    "nemo_automodel.components.distributed.nvfsdp.NVFSDPManager":
+        "automodel_tpu.distributed.mesh.MeshManager",
+    "nemo_automodel.components.distributed.ddp.DDPManager":
+        "automodel_tpu.distributed.mesh.MeshManager",
+    # torch-ecosystem dataloader -> stateful numpy loader.
+    "torchdata.stateful_dataloader.StatefulDataLoader":
+        "automodel_tpu.datasets.dataloader.StatefulDataLoader",
+}
+# Module-prefix rewrites applied when no exact alias matched (order matters:
+# first hit wins, longest prefixes first).
+_PREFIX_ALIASES = [
+    ("nemo_automodel.components._peft.", "automodel_tpu.peft."),
+    ("nemo_automodel.components._transformers.", "automodel_tpu.models."),
+    ("nemo_automodel.components.models.", "automodel_tpu.models."),
+    ("nemo_automodel.components.", "automodel_tpu."),
+    ("nemo_automodel.recipes.", "automodel_tpu.recipes."),
+    ("nemo_automodel.shared.", "automodel_tpu.utils."),
+]
+
+
+def translate_target(target: str) -> str:
+    """Map a reference-framework ``_target_`` path to its TPU-native home.
+
+    Returns ``target`` unchanged when no alias applies.  ``torch.optim.*``
+    is deliberately NOT translated here: the recipes route those through
+    :func:`automodel_tpu.optim.build_optimizer` which speaks torch kwargs.
+    """
+    new = None
+    for old, repl in _TARGET_ALIASES.items():
+        # Exact hit, or alias-as-prefix for method targets such as
+        # "nemo_automodel.NeMoAutoModelForCausalLM.from_pretrained".
+        if target == old or target.startswith(old + "."):
+            new = repl + target[len(old):]
+            break
+    if new is None:
+        for old_prefix, new_prefix in _PREFIX_ALIASES:
+            if target.startswith(old_prefix):
+                new = new_prefix + target[len(old_prefix):]
+                break
+        else:
+            return target
+    if target not in _translated_seen:
+        _translated_seen.add(target)
+        logger.info("Translating reference _target_ %r -> %r", target, new)
+    return new
+
+
+_translated_seen: set = set()
 
 
 class TargetResolutionError(ImportError):
@@ -86,6 +159,7 @@ def resolve_target(target: str) -> Any:
     """
     if not isinstance(target, str):
         return target  # already a callable (e.g. set programmatically)
+    target = translate_target(target)
     if ".py:" in target:
         path, _, symbol = target.rpartition(":")
         return _import_from_file(path, symbol)

@@ -149,6 +149,52 @@ def qwen2_5_collate_fn(examples: List[dict], processor,
     return _collate(examples, processor, start_of_response_token)
 
 
+def phi4_mm_collate_fn(examples: List[dict], processor,
+                       max_length: int = 1024) -> Dict[str, np.ndarray]:
+    """Phi-4-multimodal audio path (reference ``collate_fns.py:77-117``):
+    the supervised span is located by matching the assistant turn's own
+    token ids inside ``input_ids`` (no chat-template response marker), and
+    image-embed side tensors are dropped."""
+    conversations = [ex["conversation"] for ex in examples]
+    for conv in conversations:
+        if conv[1].get("role") not in (None, "assistant"):
+            raise ValueError(
+                "phi4_mm_collate_fn expects [user, assistant] conversations; "
+                f"turn 1 has role {conv[1].get('role')!r}")
+    texts = [processor.apply_chat_template(c, tokenize=False)
+             for c in conversations]
+    audios = []
+    for ex in examples:
+        a = ex.get("audio")
+        audios.append((a["array"], a["sampling_rate"])
+                      if isinstance(a, dict) else a)
+    batch = processor(text=texts, audios=audios, padding=True,
+                      truncation=True, max_length=max_length,
+                      return_tensors="np")
+    input_ids = _as_numpy(batch["input_ids"]).astype(np.int32)
+
+    tokenizer = getattr(processor, "tokenizer", processor)
+    loss_masks: List[List[int]] = []
+    for row, conv in zip(input_ids, conversations):
+        ids = [int(t) for t in row]
+        answer = tokenizer(conv[1]["content"],
+                           add_special_tokens=False)["input_ids"]
+        mask = [0] * len(ids)
+        start = find_response_start(ids, answer)
+        if start:  # mark the matched answer span itself, not its suffix
+            mask[start - len(answer):start] = [1] * len(answer)
+        loss_masks.append(mask)
+
+    out: Dict[str, np.ndarray] = {"input_ids": input_ids}
+    for key in ("input_audio_embeds", "audio_embed_sizes", "audio_attention_mask"):
+        if batch.get(key) is not None:
+            out[key] = _as_numpy(batch[key])
+    out["labels"] = _shifted_masked_labels(
+        input_ids, extract_skipped_token_ids(processor), loss_masks)
+    out["loss_mask"] = np.asarray(loss_masks, np.float32)
+    return out
+
+
 def default_collate_fn(examples: List[dict], processor,
                        start_of_response_token: Optional[str] = None
                        ) -> Dict[str, np.ndarray]:
@@ -159,5 +205,6 @@ def default_collate_fn(examples: List[dict], processor,
 # Processor class name -> collate fn (reference ``collate_fns.py:187-190``).
 COLLATE_FNS = {
     "Qwen2_5_VLProcessor": qwen2_5_collate_fn,
+    "Phi4MMProcessor": phi4_mm_collate_fn,
     "default": default_collate_fn,
 }

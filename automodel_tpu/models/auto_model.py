@@ -77,6 +77,17 @@ class AutoModelForCausalLM:
         return model
 
 
+class AutoModelForImageTextToText:
+    """VLM facade — same registry-driven construction as
+    :class:`AutoModelForCausalLM` (the registry routes ``model_type`` to the
+    right family, so one implementation serves both; the reference keeps a
+    separate ``NeMoAutoModelForImageTextToText``,
+    ``_transformers/auto_model.py:448-640``)."""
+
+    from_config = AutoModelForCausalLM.from_config
+    from_pretrained = AutoModelForCausalLM.from_pretrained
+
+
 def build_model(name_or_path: Optional[str] = None, config: Optional[dict] = None,
                 **kwargs) -> Any:
     """YAML-friendly builder: from checkpoint path or inline config dict."""
