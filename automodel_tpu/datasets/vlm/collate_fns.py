@@ -19,12 +19,15 @@ TPU-native contract (what ``training/train_step.py`` consumes):
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from automodel_tpu.datasets.utils import CROSS_ENTROPY_IGNORE_IDX
 from automodel_tpu.datasets.vlm.utils import extract_skipped_token_ids
+
+logger = logging.getLogger(__name__)
 
 
 def _as_numpy(x: Any) -> np.ndarray:
@@ -157,10 +160,11 @@ def phi4_mm_collate_fn(examples: List[dict], processor,
     image-embed side tensors are dropped."""
     conversations = [ex["conversation"] for ex in examples]
     for conv in conversations:
-        if conv[1].get("role") not in (None, "assistant"):
+        if len(conv) < 2 or conv[1].get("role") != "assistant":
             raise ValueError(
                 "phi4_mm_collate_fn expects [user, assistant] conversations; "
-                f"turn 1 has role {conv[1].get('role')!r}")
+                f"got {len(conv)} turns, turn-1 role "
+                f"{conv[1].get('role') if len(conv) > 1 else None!r}")
     texts = [processor.apply_chat_template(c, tokenize=False)
              for c in conversations]
     audios = []
@@ -183,6 +187,12 @@ def phi4_mm_collate_fn(examples: List[dict], processor,
         start = find_response_start(ids, answer)
         if start:  # mark the matched answer span itself, not its suffix
             mask[start - len(answer):start] = [1] * len(answer)
+        else:
+            logger.warning(
+                "phi4_mm_collate_fn: assistant answer not found in input_ids "
+                "(truncated at max_length=%d, or context-dependent "
+                "tokenization); example contributes no supervised tokens",
+                max_length)
         loss_masks.append(mask)
 
     out: Dict[str, np.ndarray] = {"input_ids": input_ids}

@@ -4,9 +4,10 @@ This is the reference-semantics attention path (the reference's SDPA fallback,
 ``_transformers/auto_model.py:50-88``).  Sequence packing uses *segment ids*
 instead of the reference's 4-D block-diagonal masks
 (``datasets/llm/packed_sequence.py:278-322``) — the TPU-idiomatic encoding that
-Pallas flash kernels consume directly.  A Pallas flash-attention kernel
-(`automodel_tpu.ops.pallas.flash_attention`) overrides this on TPU for long
-sequences; this XLA version is the portable fallback and the CPU test path.
+Pallas kernels consume directly.  On TPU the splash-attention kernel
+(``automodel_tpu.ops.splash_attention``) overrides this, with plain Pallas
+flash (``automodel_tpu.ops.flash_attention``) as the secondary path on older
+JAX; this XLA version is the portable fallback and the CPU test path.
 """
 
 from __future__ import annotations
@@ -129,8 +130,8 @@ def attention(
     * active sharding context with ``cp > 1``  -> **ring attention**
       (``shard_map`` + ``ppermute`` over the cp axis; the reference's
       ``context_parallel``, ``distributed/cp_utils.py:102-149``);
-    * TPU backend + block-aligned shapes       -> **Pallas flash attention**
-      (segment-id native);
+    * TPU backend + block-aligned shapes       -> **splash attention**
+      (segment-id native, GQA without kv repeat, causal blocks skipped);
     * otherwise                                -> XLA SDPA (this module) —
       always correct under GSPMD, used on CPU test meshes.
     """
@@ -147,23 +148,42 @@ def attention(
             return sharded_ring_attention(
                 q, k, v, mesh, causal=causal, segment_ids=seg, scale=scale)
 
-    from automodel_tpu.ops.flash_attention import (
-        flash_attention_available,
-        flash_attention_bshd,
-        sharded_flash_attention,
-    )
+    try:
+        from automodel_tpu.ops.splash_attention import (
+            sharded_splash_attention,
+            splash_attention_available,
+            splash_attention_bshd,
+        )
 
-    if logits_soft_cap is None and flash_attention_available(
-            q.shape[1], k.shape[1], q.shape[3],
-            attention_mask is not None):
-        if ctx is not None:
-            # pallas_call must run per-shard under GSPMD
-            return sharded_flash_attention(
-                q, k, v, ctx[0], causal=causal, segment_ids=segment_ids,
+        if splash_attention_available(q.shape[1], k.shape[1], q.shape[3]):
+            if ctx is not None:
+                # pallas_call must run per-shard under GSPMD
+                return sharded_splash_attention(
+                    q, k, v, ctx[0], causal=causal, segment_ids=segment_ids,
+                    attention_mask=attention_mask, scale=scale,
+                    logits_soft_cap=logits_soft_cap)
+            return splash_attention_bshd(
+                q, k, v, causal=causal, segment_ids=segment_ids,
+                attention_mask=attention_mask, scale=scale,
+                logits_soft_cap=logits_soft_cap)
+    except ImportError:
+        # Older JAX without the splash kernel: plain Pallas flash attention
+        # (kv heads repeated for GQA) is the secondary TPU path.
+        from automodel_tpu.ops.flash_attention import (
+            flash_attention_available,
+            flash_attention_bshd,
+            sharded_flash_attention,
+        )
+
+        if logits_soft_cap is None and flash_attention_available(
+                q.shape[1], k.shape[1], q.shape[3]):
+            if ctx is not None:
+                return sharded_flash_attention(
+                    q, k, v, ctx[0], causal=causal, segment_ids=segment_ids,
+                    attention_mask=attention_mask, scale=scale)
+            return flash_attention_bshd(
+                q, k, v, causal=causal, segment_ids=segment_ids,
                 attention_mask=attention_mask, scale=scale)
-        return flash_attention_bshd(
-            q, k, v, causal=causal, segment_ids=segment_ids,
-            attention_mask=attention_mask, scale=scale)
 
     return dot_product_attention(
         q, k, v, causal=causal, segment_ids=segment_ids,

@@ -26,8 +26,7 @@ logger = logging.getLogger(__name__)
 _BLOCK = 128  # minimum pallas flash block (MIN_BLOCK_SIZE)
 
 
-def flash_attention_available(q_seq: int, kv_seq: int, head_dim: int,
-                              has_padding_mask: bool) -> bool:
+def flash_attention_available(q_seq: int, kv_seq: int, head_dim: int) -> bool:
     try:
         backend = jax.default_backend()
     except Exception:

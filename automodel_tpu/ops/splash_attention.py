@@ -1,0 +1,180 @@
+"""Splash attention: the TPU sparse-flash kernel with NATIVE grouped-query
+support — no kv-head repeat.
+
+Replaces the plain Pallas flash path on the training hot loop (reference
+analogue: the FlashAttention-2 fast path, ``nemo_automodel/components/
+_transformers/auto_model.py:50-144``).  Advantages over
+``ops/flash_attention.py``:
+
+* **GQA without materializing kv repeats** — q is viewed as
+  ``[Hkv, G, S, D]`` and the MQA kernel is vmapped over kv heads, so kv
+  bandwidth stays at ``Hkv/Hq`` of the repeat path (4x less for Llama-3).
+* **soft-cap support** (``attn_logits_soft_cap``) — lifts the Gemma-style
+  restriction the flash path had.
+* mask structure is processed host-side once per shape and skipped blocks
+  are never executed (causal = ~2x fewer FLOPs, exactly).
+
+Segment ids (packed sequences) and padding masks use the framework-wide
+convention: pad positions get segment 0 (``ops/attention.py:
+fold_padding_into_segments``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_BLOCK = 128  # minimum legal splash block edge
+
+# Pallas interpret mode: lets the CPU test suite execute the real kernel
+# logic (tests monkeypatch this; the dispatcher never routes CPU traffic
+# here on its own — see splash_attention_available).
+_INTERPRET = False
+
+
+def splash_attention_available(q_seq: int, kv_seq: int, head_dim: int) -> bool:
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return False
+    return (
+        backend == "tpu"
+        and q_seq % _BLOCK == 0
+        and kv_seq % _BLOCK == 0
+        and head_dim >= 8
+    )
+
+
+def _pick_block(n: int) -> int:
+    for b in (1024, 512, 256, 128):
+        if n % b == 0:
+            return b
+    return n
+
+
+@functools.lru_cache(maxsize=64)
+def _build_kernel(q_seq: int, kv_seq: int, q_heads_per_kv: int,
+                  causal: bool, soft_cap: Optional[float],
+                  interpret: bool = False):
+    """Mask processing runs host-side on numpy and is the expensive part —
+    cache the built kernel per (shape, group, mask) signature.
+
+    ``ensure_compile_time_eval`` keeps the kernel's mask-info arrays real
+    device constants even when this is first called inside a jit trace;
+    without it the cached kernel would hold leaked tracers."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+        splash_attention_mask as sm,
+    )
+
+    head_mask = (sm.CausalMask((q_seq, kv_seq)) if causal
+                 else sm.FullMask((q_seq, kv_seq)))
+    mask = sm.MultiHeadMask([head_mask for _ in range(q_heads_per_kv)])
+    bq, bkv = _pick_block(q_seq), _pick_block(kv_seq)
+    # Fused dq+dkv backward (one bwd pass instead of two) with kv-compute
+    # sub-blocks at half the kv block: best of the measured grid on the
+    # Llama-1B/v5e bench (~+6% step time vs plain 512 blocks + split bwd);
+    # block_*_dq are unused in fused mode.
+    bkvc = max(bkv // 2, _BLOCK)
+    sizes = sk.BlockSizes(
+        block_q=bq, block_kv=bkv, block_kv_compute=bkvc,
+        block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkvc,
+        use_fused_bwd_kernel=True,
+    )
+    with jax.ensure_compile_time_eval():
+        return sk.make_splash_mqa_single_device(
+            mask=mask, block_sizes=sizes, attn_logits_soft_cap=soft_cap,
+            interpret=interpret)
+
+
+def splash_attention_bshd(
+    q: jnp.ndarray,                         # [B, S, Hq, D]
+    k: jnp.ndarray,                         # [B, Skv, Hk, D]
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jnp.ndarray] = None,     # [B, S]
+    attention_mask: Optional[jnp.ndarray] = None,  # [B, Skv] padding mask
+    scale: Optional[float] = None,
+    logits_soft_cap: Optional[float] = None,
+) -> jnp.ndarray:
+    """Splash attention in the framework's [B, S, H, D] convention."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+    )
+
+    from automodel_tpu.ops.attention import fold_padding_into_segments
+
+    B, S, Hq, D = q.shape
+    Skv, Hk = k.shape[1], k.shape[2]
+    assert Hq % Hk == 0, f"query heads {Hq} not a multiple of kv heads {Hk}"
+    G = Hq // Hk
+    scale = D ** -0.5 if scale is None else scale
+
+    segment_ids = fold_padding_into_segments((B, S), segment_ids,
+                                             attention_mask)
+
+    kernel = _build_kernel(S, Skv, G, causal,
+                           None if logits_soft_cap is None
+                           else float(logits_soft_cap),
+                           interpret=_INTERPRET)
+
+    # The kernel has no sm_scale param: fold the scale into q.
+    qs = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)
+    qs = qs.reshape(B, Hk, G, S, D)
+    kt = k.transpose(0, 2, 1, 3)            # [B, Hk, Skv, D]
+    vt = v.transpose(0, 2, 1, 3)
+
+    per_kv = jax.vmap(kernel, in_axes=(0, 0, 0, None))      # over kv heads
+    if segment_ids is None:
+        out = jax.vmap(per_kv, in_axes=(0, 0, 0, None))(qs, kt, vt, None)
+    else:
+        seg = sk.SegmentIds(q=segment_ids.astype(jnp.int32),
+                            kv=segment_ids.astype(jnp.int32))
+        out = jax.vmap(per_kv, in_axes=(0, 0, 0, 0))(qs, kt, vt, seg)
+    # [B, Hk, G, S, D] -> [B, S, Hq, D]
+    return out.reshape(B, Hq, S, D).transpose(0, 2, 1, 3)
+
+
+def sharded_splash_attention(
+    q, k, v, mesh, *,
+    causal: bool = True,
+    segment_ids=None,
+    attention_mask=None,
+    scale=None,
+    logits_soft_cap=None,
+    batch_axes=("dp_replicate", "dp_shard"),
+    head_axis: str = "tp",
+):
+    """shard_map wrapper: a pallas_call runs per-shard under GSPMD — batch
+    over dp, heads over tp, sequence whole (cp>1 routes to ring attention
+    before reaching here)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from automodel_tpu.ops.attention import fold_padding_into_segments
+
+    B, S = q.shape[:2]
+    segment_ids = fold_padding_into_segments((B, S), segment_ids,
+                                             attention_mask)
+
+    qspec = P(tuple(batch_axes), None, head_axis, None)
+    sspec = P(tuple(batch_axes), None)
+
+    def inner(q, k, v, seg):
+        return splash_attention_bshd(
+            q, k, v, causal=causal, segment_ids=seg, scale=scale,
+            logits_soft_cap=logits_soft_cap)
+
+    if segment_ids is None:
+        return shard_map(
+            lambda q, k, v: inner(q, k, v, None), mesh=mesh,
+            in_specs=(qspec, qspec, qspec), out_specs=qspec,
+            check_vma=False)(q, k, v)
+    return shard_map(
+        inner, mesh=mesh,
+        in_specs=(qspec, qspec, qspec, sspec), out_specs=qspec,
+        check_vma=False)(q, k, v, segment_ids.astype(jnp.int32))

@@ -1,0 +1,22 @@
+"""On-hardware test suite: runs on the real TPU backend.
+
+Unlike ``tests/`` (which pins an 8-device virtual CPU platform), this
+directory uses whatever accelerator the environment provides and skips
+itself entirely when none is available.  Run manually:
+
+    python -m pytest tpu_tests/ -q
+"""
+
+import jax
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        on_tpu = False
+    if not on_tpu:
+        skip = pytest.mark.skip(reason="no TPU backend")
+        for item in items:
+            item.add_marker(skip)
