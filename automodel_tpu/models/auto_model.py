@@ -50,10 +50,18 @@ class AutoModelForCausalLM:
 
     @staticmethod
     def from_config(config: Any, **model_kwargs) -> Any:
-        """Build from an HF-style config dict (or a ready config dataclass)."""
+        """Build from an HF-style config dict (or a ready config dataclass).
+
+        ``param_dtype`` defaults to the checkpoint's ``torch_dtype`` (bf16
+        for Llama-3.x) — weights live in the dtype the model shipped with,
+        matching HF/reference load behavior and the MXU-native type, instead
+        of silently upcasting everything to fp32."""
         if isinstance(config, dict):
             family = get_family(config.get("model_type", "llama"))
             config = family.config_cls.from_hf_config(config)
+        ckpt_dtype = getattr(config, "torch_dtype", None)
+        if ckpt_dtype:
+            model_kwargs.setdefault("param_dtype", str(ckpt_dtype))
         return get_family(config.model_type).model_cls(config, **model_kwargs)
 
     @staticmethod

@@ -94,6 +94,10 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
     """``setup()`` then ``run_train_validation_loop()`` (reference
     ``vlm/finetune.py:496``)."""
 
+    # VLM training clips at 1.0 by default (reference ``vlm/finetune.py:641``);
+    # YAML ``max_grad_norm: null`` disables.
+    _default_max_grad_norm = 1.0
+
     def _build_freeze_mask(self):
         """``freeze_config`` YAML, defaulting to frozen embeddings when the
         section is absent (reference ``_freeze_model``,

@@ -1,11 +1,14 @@
-"""Benchmark: Llama-1B-shape training step throughput on one TPU chip.
+"""Benchmark: Llama-1B training throughput through the REAL recipe path.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Measures the full jitted train step (fwd + fused-linear CE + bwd + AdamW) on
-a Llama-3.2-1B-shaped model, bf16 params, remat on — the BASELINE.md
-north-star config scaled to the single available chip.  ``vs_baseline`` is
-MFU / 0.40 (the ≥40% MFU v5e target).
+Drives ``examples/llm_finetune/llama3_2/llama3_2_1b_bench.yaml`` — the
+north-star hellaswag recipe with offline fixtures — through
+``TrainFinetuneRecipeForNextTokenPrediction.setup()`` and
+``_run_train_optim_step``, so the measured number is what a user of the
+YAML recipes actually gets (bf16 params from the checkpoint torch_dtype,
+fused-linear CE, splash attention, packed sequences).  ``vs_baseline`` is
+MFU / 0.40 (the ≥40% MFU v5e target from BASELINE.md).
 """
 
 from __future__ import annotations
@@ -19,76 +22,60 @@ import numpy as np
 # v5e peak bf16 TFLOP/s per chip; override for other TPU generations.
 PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
 SMALL = bool(int(os.environ.get("BENCH_SMALL", "0")))
+YAML = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "examples", "llm_finetune", "llama3_2",
+                    "llama3_2_1b_bench.yaml")
 
 
 def main() -> None:
-    import jax
-    import jax.numpy as jnp
-
-    from automodel_tpu.loss.linear_ce import FusedLinearCrossEntropy
-    from automodel_tpu.loss.masked_ce import IGNORE_INDEX
-    from automodel_tpu.models.llama import (
-        LlamaConfig,
-        LlamaForCausalLM,
-        llama3_2_1b_config,
+    from automodel_tpu.config.arg_parser import parse_args_and_load_config
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
     )
-    from automodel_tpu.optim import build_optimizer
-    from automodel_tpu.training.train_step import build_train_step
 
+    overrides = []
     if SMALL:
-        cfg = LlamaConfig(
-            vocab_size=2048, hidden_size=256, intermediate_size=1024,
-            num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4,
-            rope_theta=10000.0)
-        B, S, steps, warmup = 4, 512, 5, 2
-    else:
-        cfg = llama3_2_1b_config()
-        B, S, steps, warmup = int(os.environ.get("BENCH_BATCH", "4")), 2048, 10, 3
+        overrides = [
+            "--model.config.hidden_size", "256",
+            "--model.config.intermediate_size", "1024",
+            "--model.config.num_hidden_layers", "4",
+            "--model.config.num_attention_heads", "8",
+            "--model.config.num_key_value_heads", "4",
+            "--model.config.head_dim", "32",
+            "--model.config.vocab_size", "2048",
+            "--dataset.num_sentences", "64",
+            "--dataset.mean_len", "96",
+            "--dataset.max_sentence_len", "127",
+            "--packed_sequence.packed_sequence_size", "512",
+        ]
+    steps, warmup = (5, 2) if SMALL else (10, 3)
 
-    model = LlamaForCausalLM(cfg, param_dtype=jnp.bfloat16,
-                             compute_dtype=jnp.bfloat16, remat=True)
-    quant = os.environ.get("BENCH_QUANT", "")   # "" | "int8" | "float8"
-    if quant:
-        from automodel_tpu.quantization.fp8 import (
-            apply_fp8_to_model,
-            build_fp8_config,
-        )
+    cfg = parse_args_and_load_config(["--config", YAML] + overrides)
+    recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
 
-        apply_fp8_to_model(model, build_fp8_config(
-            enabled=True, dtype=quant, recipe_name="tensorwise"))
-    tx = build_optimizer(name="adamw", lr=1e-4, weight_decay=0.01,
-                         mu_dtype=jnp.bfloat16)
-    fns = build_train_step(
-        model, tx, loss_fn=FusedLinearCrossEntropy(chunk_len=1024),
-        grad_dtype=jnp.bfloat16)
+    groups = iter(recipe.step_scheduler)
 
-    params = model.init(jax.random.key(0))
-    opt_state = fns.init_opt_state(params)
-
-    rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size - 1, (1, B, S))
-    labels = np.roll(ids, -1, -1)
-    labels[..., -1] = IGNORE_INDEX
-    batch = {
-        "input_ids": jnp.asarray(ids, jnp.int32),
-        "labels": jnp.asarray(labels, jnp.int32),
-    }
+    def one_step():
+        batches = next(groups)
+        tokens = sum(int(np.asarray(b["input_ids"]).size) for b in batches)
+        return recipe._run_train_optim_step(batches), tokens
 
     for _ in range(warmup):
-        params, opt_state, m = fns.train_step(params, opt_state, batch)
-    # device_get, not block_until_ready: remote-tunnel runtimes may return
-    # from block_until_ready before execution finishes; a value fetch cannot.
-    float(m["loss"])
+        m, _ = one_step()
+
+    recipe.flush_metrics()   # drain in-flight work before the timed window
 
     t0 = time.perf_counter()
+    total_tokens = 0
     for _ in range(steps):
-        params, opt_state, m = fns.train_step(params, opt_state, batch)
-    final_loss = float(m["loss"])  # chained deps: syncs all timed steps
+        m, tokens = one_step()
+        total_tokens += tokens
+    m = recipe.flush_metrics()  # device-syncs the last dispatched step
     dt = time.perf_counter() - t0
-    assert np.isfinite(final_loss)
+    assert np.isfinite(m["loss"])
 
-    tokens_per_sec = B * S * steps / dt
-    mfu = tokens_per_sec * model.flops_per_token() / PEAK_FLOPS
+    tokens_per_sec = total_tokens / dt
+    mfu = tokens_per_sec * recipe.model.flops_per_token() / PEAK_FLOPS
     print(json.dumps({
         "metric": "llama1b_sft_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),

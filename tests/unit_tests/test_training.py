@@ -141,3 +141,69 @@ def test_stack_microbatches():
     ]
     out = stack_microbatches(mbs)
     assert out["input_ids"].shape == (2, 2, 4)
+
+
+def test_max_grad_norm_yaml_plumbs_into_optimizer(tmp_path):
+    import os
+
+    from automodel_tpu.config.arg_parser import parse_args_and_load_config
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
+    )
+
+    yaml_path = os.path.join(os.path.dirname(__file__), "..", "..",
+                             "examples", "llm_finetune", "tiny_llama_mock.yaml")
+    import jax
+    import numpy as np
+
+    clip, lr = 1e-3, 1.0
+    cfg = parse_args_and_load_config(
+        ["--config", yaml_path,
+         "--checkpoint.enabled", "false",
+         "--max_grad_norm", str(clip),
+         "--optimizer._target_", "torch.optim.SGD",
+         "--optimizer.lr", str(lr),
+         "--optimizer.momentum", "0.0",
+         "--optimizer.weight_decay", "0.0",
+         "--step_scheduler.max_steps", "1",
+         "--lr_scheduler.lr_warmup_steps", "0",
+         "--lr_scheduler.lr_decay_style", "constant"])
+    r = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+    before = jax.tree.map(lambda x: np.asarray(x, np.float64), r.params)
+    m = r._run_train_optim_step(next(iter(r.step_scheduler)))
+    assert m["grad_norm"] > clip  # the raw gradient really needed clipping
+    after = jax.tree.map(lambda x: np.asarray(x, np.float64), r.params)
+    # SGD + in-chain global-norm clip: |delta params| <= lr * max_grad_norm
+    delta_sq = jax.tree.map(
+        lambda a, b: float(((a - b) ** 2).sum()), after, before)
+    update_norm = float(np.sqrt(sum(jax.tree.leaves(delta_sq))))
+    assert update_norm <= lr * clip * 1.05, update_norm
+
+
+def test_peak_memory_metric_from_device_stats(monkeypatch):
+    """_finalize_metrics reads peak_bytes_in_use into peak_memory_gb."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from automodel_tpu.recipes.llm import train_ft
+
+    class FakeDevice:
+        def memory_stats(self):
+            return {"peak_bytes_in_use": 3 * 1024**3}
+
+    monkeypatch.setattr(train_ft.jax, "local_devices",
+                        lambda: [FakeDevice()])
+    recipe = train_ft.TrainFinetuneRecipeForNextTokenPrediction.__new__(
+        train_ft.TrainFinetuneRecipeForNextTokenPrediction)
+    pending = {
+        "device_metrics": {"loss": np.float32(1.0),
+                           "grad_norm": np.float32(0.5),
+                           "num_label_tokens": np.int32(7)},
+        "step": 3, "lr": 1e-4, "num_tokens": 100,
+        "t_dispatch": _time.perf_counter(),
+    }
+    out = recipe._finalize_metrics(pending)
+    assert out["peak_memory_gb"] == 3.0
+    assert out["loss"] == 1.0 and out["step"] == 3
