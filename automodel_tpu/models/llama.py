@@ -23,11 +23,20 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+import zlib
+
 from automodel_tpu.distributed.shardings import constrain
 from automodel_tpu.ops.attention import attention
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.quant import maybe_qdot
 from automodel_tpu.ops.rotary import apply_rope, rope_frequencies
+
+
+def _stable_hash(name: str) -> int:
+    """Process-independent int for rng folds (``hash()`` is salted per
+    process — different fold constants per host would desync the traced
+    programs on a multi-host mesh)."""
+    return zlib.crc32(name.encode())
 
 
 @dataclasses.dataclass
@@ -190,7 +199,9 @@ class LlamaForCausalLM:
 
     # -- forward -----------------------------------------------------------
     def _decoder_layer(self, hidden, layer_params, position_ids, segment_ids,
-                       attention_mask, inv_freq):
+                       attention_mask, inv_freq, adapters=None,
+                       adapter_scale=1.0, adapter_dropout=0.0,
+                       dropout_position="post", dropout_rng=None):
         cfg = self.config
         B, S, H = hidden.shape
         D, Hq, Hk = cfg.head_dim, cfg.num_attention_heads, cfg.num_key_value_heads
@@ -199,6 +210,28 @@ class LlamaForCausalLM:
 
         def proj(x, w, name):
             y = maybe_qdot(x, w["kernel"].astype(cd), self.quant, name)
+            if adapters is not None and name in adapters:
+                # Rank-r LoRA bypass: y += s * (x@A)@B — never materializes
+                # the merged [in, out] kernel (reference Triton path intent,
+                # ``_peft/lora.py:67-214``, done the XLA way).
+                ab = adapters[name]
+                xa = x
+                if adapter_dropout > 0.0 and dropout_rng is not None \
+                        and dropout_position == "pre":
+                    keep = 1.0 - adapter_dropout
+                    m = jax.random.bernoulli(
+                        jax.random.fold_in(dropout_rng, _stable_hash(name)),
+                        keep, x.shape)
+                    xa = jnp.where(m, x / keep, 0.0).astype(x.dtype)
+                delta = (xa @ ab["A"].astype(cd)) @ ab["B"].astype(cd)
+                if adapter_dropout > 0.0 and dropout_rng is not None \
+                        and dropout_position == "post":
+                    keep = 1.0 - adapter_dropout
+                    m = jax.random.bernoulli(
+                        jax.random.fold_in(dropout_rng, _stable_hash(name)),
+                        keep, delta.shape)
+                    delta = jnp.where(m, delta / keep, 0.0).astype(delta.dtype)
+                y = y + jnp.asarray(adapter_scale, cd) * delta
             if "bias" in w:
                 y = y + w["bias"].astype(cd)
             return y
@@ -219,21 +252,17 @@ class LlamaForCausalLM:
             segment_ids=segment_ids,
             attention_mask=attention_mask,
         )
-        attn = maybe_qdot(attn.reshape(B, S, Hq * D),
-                          p["self_attn"]["o_proj"]["kernel"].astype(cd),
-                          self.quant, "self_attn.o_proj")
+        attn = proj(attn.reshape(B, S, Hq * D), p["self_attn"]["o_proj"],
+                    "self_attn.o_proj")
         hidden = resid + attn
 
         # MLP block (SwiGLU)
         resid = hidden
         x = rms_norm(hidden, p["post_attention_layernorm"]["weight"], cfg.rms_norm_eps)
-        gate = maybe_qdot(x, p["mlp"]["gate_proj"]["kernel"].astype(cd),
-                          self.quant, "mlp.gate_proj")
-        up = maybe_qdot(x, p["mlp"]["up_proj"]["kernel"].astype(cd),
-                        self.quant, "mlp.up_proj")
-        down = maybe_qdot(jax.nn.silu(gate) * up,
-                          p["mlp"]["down_proj"]["kernel"].astype(cd),
-                          self.quant, "mlp.down_proj")
+        gate = proj(x, p["mlp"]["gate_proj"], "mlp.gate_proj")
+        up = proj(x, p["mlp"]["up_proj"], "mlp.up_proj")
+        down = proj(jax.nn.silu(gate) * up, p["mlp"]["down_proj"],
+                    "mlp.down_proj")
         # SP/CP activation layout between blocks (no-op without a sharding ctx)
         return constrain(resid + down, ("act_batch", "act_seq", "act_embed"))
 
@@ -245,15 +274,28 @@ class LlamaForCausalLM:
         segment_ids: Optional[jnp.ndarray] = None,
         attention_mask: Optional[jnp.ndarray] = None,
         return_hidden: bool = False,
+        adapters: Optional[Dict[str, Any]] = None,
+        adapter_scale: float = 1.0,
+        adapter_dropout: float = 0.0,
+        adapter_dropout_position: str = "post",
+        dropout_rng: Optional[jax.Array] = None,
     ) -> Dict[str, jnp.ndarray]:
         """Forward pass. Returns ``{"logits": ...}`` or, with ``return_hidden``,
         ``{"hidden_states": ..., "lm_head_kernel": ...}`` for fused linear CE
-        (the reference's logits_to_keep path, ``recipes/llm/train_ft.py:436-460``)."""
+        (the reference's logits_to_keep path, ``recipes/llm/train_ft.py:436-460``).
+
+        ``adapters``: rank-r LoRA bypass weights, keyed by in-layer module
+        path (``"self_attn.q_proj"``) with layer-stacked ``{"A": [L, in, r],
+        "B": [L, r, out]}`` values — they ride the layer scan next to the
+        base params (see ``automodel_tpu/peft/lora.py``)."""
         hidden = params["embed_tokens"]["embedding"][input_ids].astype(self.compute_dtype)
         return self.forward_embeds(
             params, hidden, position_ids=position_ids,
             segment_ids=segment_ids, attention_mask=attention_mask,
-            return_hidden=return_hidden)
+            return_hidden=return_hidden, adapters=adapters,
+            adapter_scale=adapter_scale, adapter_dropout=adapter_dropout,
+            adapter_dropout_position=adapter_dropout_position,
+            dropout_rng=dropout_rng)
 
     def forward_embeds(
         self,
@@ -263,6 +305,11 @@ class LlamaForCausalLM:
         segment_ids: Optional[jnp.ndarray] = None,
         attention_mask: Optional[jnp.ndarray] = None,
         return_hidden: bool = False,
+        adapters: Optional[Dict[str, Any]] = None,
+        adapter_scale: float = 1.0,
+        adapter_dropout: float = 0.0,
+        adapter_dropout_position: str = "post",
+        dropout_rng: Optional[jax.Array] = None,
     ) -> Dict[str, jnp.ndarray]:
         """Forward from input embeddings — the VLM path (image features
         already merged into the token stream)."""
@@ -274,9 +321,24 @@ class LlamaForCausalLM:
                            ("act_batch", "act_seq", "act_embed"))
         inv_freq = jnp.asarray(self.inv_freq)
 
-        def body(h, layer_params):
+        # LoRA adapters are stacked [L, ...] like the base layer params:
+        # strip the "layers." prefix and scan them alongside.
+        layer_adapters = None
+        if adapters:
+            layer_adapters = {
+                k[len("layers."):]: v for k, v in adapters.items()
+                if k.startswith("layers.")}
+        layer_idx = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
+
+        def body(h, xs):
+            layer_params, ad, idx = xs
+            rng = (jax.random.fold_in(dropout_rng, idx)
+                   if dropout_rng is not None else None)
             return self._decoder_layer(
-                h, layer_params, position_ids, segment_ids, attention_mask, inv_freq
+                h, layer_params, position_ids, segment_ids, attention_mask,
+                inv_freq, adapters=ad, adapter_scale=adapter_scale,
+                adapter_dropout=adapter_dropout,
+                dropout_position=adapter_dropout_position, dropout_rng=rng,
             ), None
 
         if self.remat:
@@ -284,7 +346,8 @@ class LlamaForCausalLM:
             if self.remat_policy and self.remat_policy != "none":
                 policy = getattr(jax.checkpoint_policies, self.remat_policy, None)
             body = jax.checkpoint(body, policy=policy, prevent_cse=False)
-        hidden, _ = lax.scan(body, hidden, params["layers"])
+        hidden, _ = lax.scan(
+            body, hidden, (params["layers"], layer_adapters, layer_idx))
 
         hidden = rms_norm(hidden, params["norm"]["weight"], cfg.rms_norm_eps)
         lm_kernel = (

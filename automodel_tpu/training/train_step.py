@@ -46,6 +46,10 @@ def _microbatch_loss(model, loss_fn, params, mb: Dict[str, jnp.ndarray]):
     loss wants hidden states (reference ``calculate_loss`` routing,
     ``train_ft.py:425-474``)."""
     kwargs = {k: mb[k] for k in _MODEL_KEYS[1:] if mb.get(k) is not None}
+    if mb.get("dropout_rng") is not None:
+        # [2] uint32 key data per microbatch (LoRA dropout; see the recipe's
+        # _device_batch) — absent at eval, so dropout is train-only.
+        kwargs["dropout_rng"] = jax.random.wrap_key_data(mb["dropout_rng"])
     labels = mb["labels"]
     if getattr(loss_fn, "needs_hidden", False):
         out = model(params, mb["input_ids"], return_hidden=True, **kwargs)

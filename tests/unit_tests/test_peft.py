@@ -126,3 +126,56 @@ def test_lora_param_axes_cover_tree():
     n_specs = len(jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P)))
     n_params = len(jax.tree.leaves(wrapped.abstract_params()))
     assert n_specs == n_params
+
+
+def test_bypass_matches_merge_path():
+    """Rank-r bypass forward == merged-kernel forward (same math, no
+    materialized W+sAB)."""
+    model = tiny_model()
+    wrapped = LoRAModel(model, PeftConfig(target_modules=["*_proj"], dim=4,
+                                          alpha=16, use_rank_r_bypass=True))
+    assert wrapped._bypass
+    params = wrapped.init(jax.random.key(3))
+    params["lora"] = jax.tree.map(
+        lambda x: x + 0.02 * jax.random.normal(
+            jax.random.key(9), x.shape, jnp.float32).astype(x.dtype),
+        params["lora"])
+    ids = jnp.arange(16, dtype=jnp.int32)[None, :]
+    bypass = wrapped(params, ids)["logits"]
+    merged = model(wrapped.merge_params(params), ids)["logits"]
+    np.testing.assert_allclose(np.asarray(bypass, np.float32),
+                               np.asarray(merged, np.float32),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_dropout_train_only_and_deterministic():
+    model = tiny_model()
+    wrapped = LoRAModel(model, PeftConfig(target_modules=["*_proj"],
+                                          dim=4, alpha=16, dropout=0.5))
+    assert wrapped.wants_dropout_rng
+    params = wrapped.init(jax.random.key(4))
+    params["lora"] = jax.tree.map(lambda x: x + 0.05, params["lora"])
+    ids = jnp.arange(16, dtype=jnp.int32)[None, :]
+
+    rng = jax.random.key(7)
+    a = wrapped(params, ids, dropout_rng=rng)["logits"]
+    b = wrapped(params, ids, dropout_rng=rng)["logits"]
+    c = wrapped(params, ids, dropout_rng=jax.random.key(8))["logits"]
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # same key
+    assert float(jnp.max(jnp.abs(a - c))) > 0  # different key -> new mask
+
+    # no rng -> dropout off -> matches the merged deterministic forward
+    off = wrapped(params, ids)["logits"]
+    merged = model(wrapped.merge_params(params), ids)["logits"]
+    np.testing.assert_allclose(np.asarray(off, np.float32),
+                               np.asarray(merged, np.float32),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_dropout_rejected_without_bypass_support():
+    from automodel_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    gpt2 = GPT2LMHeadModel(GPT2Config(
+        vocab_size=128, n_embd=32, n_layer=2, n_head=4, n_positions=64))
+    with pytest.raises(ValueError, match="dropout"):
+        LoRAModel(gpt2, PeftConfig(target_modules=["*attn*"], dropout=0.1))
