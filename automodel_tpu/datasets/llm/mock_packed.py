@@ -1,0 +1,59 @@
+"""Pre-packed synthetic dataset: fixed-size blocks of concatenated sentences.
+
+Reference parity: ``nemo_automodel/components/datasets/llm/mock_packed.py``
+(sentences are concatenated into exactly ``block_size``-token blocks with
+eos-reset position ids).  Differs from :func:`automodel_tpu.datasets.llm.
+mock.build_packed_dataset`, which exercises the real
+:class:`~automodel_tpu.datasets.llm.packed_sequence.PackedSequence` packer —
+this module produces deterministic fixed-shape blocks directly, which is what
+the reference's dataloader tests expect.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List
+
+from automodel_tpu.datasets.llm.mock import gen_sentence_ids, make_vocab
+
+EOS_ID = 1  # make_vocab convention: 0 = <pad>, 1 = <eos>
+
+
+def _block_to_example(block: List[int]) -> Dict[str, List[int]]:
+    """Position ids restart after every eos so each packed sentence sees its
+    own positions (segment boundaries for rope / attention)."""
+    pos_ids, pos = [], 0
+    for tid in block:
+        pos_ids.append(pos)
+        pos = 0 if tid == EOS_ID else pos + 1
+    return {
+        "input_ids": block,
+        "attention_mask": [1] * len(block),
+        "labels": list(block),
+        "position_ids": pos_ids,
+    }
+
+
+def build_packed_dataset(
+    *,
+    num_blocks: int = 10,
+    block_size: int = 128,
+    mean_len: float = 20.0,
+    std_len: float = 6.0,
+    vocab_size: int = 100,
+    max_sentence_len: int = 64,
+    seed: int = 0,
+    tokenizer=None,
+) -> List[Dict[str, List[int]]]:
+    """Generate ``num_blocks`` examples of exactly ``block_size`` tokens."""
+    random.seed(seed)
+    vocab = make_vocab(vocab_size)
+    blocks: List[Dict[str, List[int]]] = []
+    current: List[int] = []
+    while len(blocks) < num_blocks:
+        current.extend(gen_sentence_ids(vocab, mean_len, std_len,
+                                        max_sentence_len))
+        while len(current) >= block_size and len(blocks) < num_blocks:
+            blocks.append(_block_to_example(current[:block_size]))
+            current = current[block_size:]
+    return blocks

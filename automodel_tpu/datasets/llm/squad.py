@@ -1,52 +1,79 @@
-"""SQuAD SFT dataset: prompt/answer formatting with prompt-masked labels.
+"""SQuAD SFT dataset: question-answering rows -> prompt-masked training rows.
 
-Reference parity: ``nemo_automodel/components/datasets/llm/squad.py:37-182``
-(plain + chat-template paths, eos handling, optional fixed-length pad, the
-``___PAD_TOKEN_IDS___`` collation convention).
+Behavioral parity with ``nemo_automodel/components/datasets/llm/squad.py:
+37-182`` (plain + chat-template tokenization, eos handling, optional
+fixed-length pad, the ``___PAD_TOKEN_IDS___`` collation convention), with the
+pipeline decomposed as tokenize -> locate response -> shift/mask/pad.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 from automodel_tpu.datasets.utils import CROSS_ENTROPY_IGNORE_IDX, PAD_SENTINEL_KEY
 
 
-def _pad_to_seq_length(sample, pad_token_id, seq_length):
-    n = seq_length - len(sample)
-    return sample if n <= 0 else sample + [pad_token_id] * n
-
-
-def _add_pad_token(tokenizer):
-    pad_token_id = getattr(tokenizer, "pad_token_id", None)
-    if pad_token_id is None:
+def _ensure_pad_token(tokenizer) -> int:
+    """Tokenizers without a pad token reuse eos (HF convention)."""
+    if getattr(tokenizer, "pad_token_id", None) is None:
         tokenizer.pad_token_id = tokenizer.eos_token_id
-        pad_token_id = tokenizer.pad_token_id
     if getattr(tokenizer, "pad_token", None) is None and getattr(
             tokenizer, "eos_token", None) is not None:
         tokenizer.pad_token = tokenizer.eos_token
-    return pad_token_id
+    return tokenizer.pad_token_id
 
 
-def _package_tokenized_example(has_chat_template, input_ids, eos_token_id,
-                               pad_token_id, seq_length, context_len):
-    # llama3-style tokenizers don't append eos
-    if not has_chat_template and eos_token_id != input_ids[-1]:
-        input_ids = input_ids + [eos_token_id]
+def _answer_text(example) -> str:
+    texts = example["answers"]["text"]
+    return texts[0].strip() if texts else ""
 
-    labels = input_ids.copy()
-    input_ids = input_ids[:-1]
-    attention_mask = [1] * len(input_ids)
-    labels[:context_len] = [CROSS_ENTROPY_IGNORE_IDX] * context_len
-    labels = labels[1:]
-    assert len(input_ids) == len(labels)
+
+def _tokenize_plain(example, tokenizer) -> Tuple[list, int, bool]:
+    """``Context/Question/Answer`` prompt format; the supervised span starts
+    where the prompt tokens end."""
+    prompt = (f"Context: {example['context']}\n"
+              f"Question: {example['question']}\nAnswer:")
+    ids = tokenizer(prompt + " " + _answer_text(example))["input_ids"]
+    return ids, len(tokenizer(prompt)["input_ids"]), False
+
+
+def _tokenize_chat(example, tokenizer,
+                   start_of_turn_token: Optional[str]) -> Tuple[list, int, bool]:
+    """Chat-template format; the supervised span starts at the SECOND
+    start-of-turn marker (the assistant turn)."""
+    ids = tokenizer.apply_chat_template([
+        {"role": "user",
+         "content": f"{example['context']} {example['question']}"},
+        {"role": "assistant", "content": _answer_text(example)},
+    ])
+    response_start = 0
+    if isinstance(start_of_turn_token, str):
+        marker = tokenizer(start_of_turn_token,
+                           add_special_tokens=False)["input_ids"][0]
+        response_start = ids.index(marker, ids.index(marker) + 1)
+    return ids, response_start, True
+
+
+def _to_training_row(ids: list, response_start: int, *, eos_token_id: int,
+                     pad_token_id: int, seq_length: Optional[int],
+                     appended_eos: bool) -> dict:
+    """Shift ids into next-token labels, mask the prompt span, optionally pad
+    to a fixed length, and attach the pad-sentinel for the collater."""
+    if not appended_eos and ids[-1] != eos_token_id:
+        ids = ids + [eos_token_id]
+
+    labels = [CROSS_ENTROPY_IGNORE_IDX] * max(response_start - 1, 0) + \
+        ids[max(response_start, 1):]
+    inputs = ids[:-1]
+    attention_mask = [1] * len(inputs)
+    assert len(inputs) == len(labels)
 
     if isinstance(seq_length, int):
-        input_ids = _pad_to_seq_length(input_ids, pad_token_id, seq_length)
-        labels = _pad_to_seq_length(labels, CROSS_ENTROPY_IGNORE_IDX, seq_length)
-    attention_mask = attention_mask + [0] * (len(labels) - len(attention_mask))
+        inputs = inputs + [pad_token_id] * (seq_length - len(inputs))
+        labels = labels + [CROSS_ENTROPY_IGNORE_IDX] * (seq_length - len(labels))
+    attention_mask += [0] * (len(labels) - len(attention_mask))
     return {
-        "input_ids": input_ids,
+        "input_ids": inputs,
         "labels": labels,
         "attention_mask": attention_mask,
         PAD_SENTINEL_KEY: {
@@ -55,41 +82,6 @@ def _package_tokenized_example(has_chat_template, input_ids, eos_token_id,
             "attention_mask": 0,
         },
     }
-
-
-def _formatting_prompts_func(example, tokenizer, eos_token_id, pad_token_id,
-                             seq_length=None):
-    question = example["question"]
-    context = example["context"]
-    answer = example["answers"]["text"][0].strip() if example["answers"]["text"] else ""
-    prompt = f"Context: {context}\nQuestion: {question}\nAnswer:"
-    full_text = prompt + " " + answer
-    prompt_ids = tokenizer(prompt)["input_ids"]
-    input_ids = tokenizer(full_text)["input_ids"]
-    return _package_tokenized_example(
-        False, input_ids, eos_token_id, pad_token_id, seq_length, len(prompt_ids))
-
-
-def _formatting_prompts_func_with_chat_template(
-        example, tokenizer, eos_token_id, pad_token_id, seq_length=None,
-        start_of_turn_token=None):
-    answer = (example["answers"]["text"][0].strip()
-              if example["answers"]["text"] else "")
-    messages = [
-        {"role": "user",
-         "content": f"{example['context']} {example['question']}"},
-        {"role": "assistant", "content": answer},
-    ]
-    input_ids = tokenizer.apply_chat_template(messages)
-    if isinstance(start_of_turn_token, str):
-        start_id = tokenizer(start_of_turn_token,
-                             add_special_tokens=False)["input_ids"][0]
-        first = input_ids.index(start_id)
-        response_start = input_ids.index(start_id, first + 1)
-    else:
-        response_start = 0
-    return _package_tokenized_example(
-        True, input_ids, eos_token_id, pad_token_id, seq_length, response_start)
 
 
 def make_squad_dataset(
@@ -108,14 +100,18 @@ def make_squad_dataset(
         split = f"{split}[:{limit_dataset_samples}]"
     dataset = load_dataset(dataset_name, split=split)
     eos_token_id = tokenizer.eos_token_id
-    pad_token_id = _add_pad_token(tokenizer)
+    pad_token_id = _ensure_pad_token(tokenizer)
+    use_chat = getattr(tokenizer, "chat_template", None) is not None
 
-    if getattr(tokenizer, "chat_template", None) is not None:
-        fmt = lambda ex: _formatting_prompts_func_with_chat_template(
-            ex, tokenizer, eos_token_id, pad_token_id, seq_length,
-            start_of_turn_token)
-    else:
-        fmt = lambda ex: _formatting_prompts_func(
-            ex, tokenizer, eos_token_id, pad_token_id, seq_length)
+    def fmt(example):
+        if use_chat:
+            ids, start, chat = _tokenize_chat(
+                example, tokenizer, start_of_turn_token)
+        else:
+            ids, start, chat = _tokenize_plain(example, tokenizer)
+        return _to_training_row(
+            ids, start, eos_token_id=eos_token_id, pad_token_id=pad_token_id,
+            seq_length=seq_length, appended_eos=chat)
+
     return dataset.map(fmt, batched=False,
                        remove_columns=dataset.column_names)

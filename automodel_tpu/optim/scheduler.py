@@ -154,60 +154,61 @@ class OptimizerParamScheduler:
         self.current_lr = self.get_lr()
 
     # -- checkpoint round-trip --------------------------------------------
-    def state_dict(self) -> dict:
-        return {
-            "max_lr": self.max_lr,
-            "lr_warmup_steps": self.lr_warmup_steps,
-            "num_steps": self.num_steps,
-            "lr_decay_style": self.lr_decay_style,
-            "lr_decay_steps": self.lr_decay_steps,
-            "min_lr": self.min_lr,
-            "start_wd": self.start_wd,
-            "end_wd": self.end_wd,
-            "wd_incr_style": self.wd_incr_style,
-            "wd_incr_steps": self.wd_incr_steps,
-        }
+    # Declarative field table: attribute name -> checkpoint keys that may
+    # carry it, newest first (older Megatron checkpoints used the aliases;
+    # reference behavior at ``optim/scheduler.py:260-313``, re-decomposed).
+    _LR_FIELDS = (
+        ("max_lr", ("max_lr", "start_lr")),
+        ("min_lr", ("min_lr",)),
+        ("lr_warmup_steps", ("lr_warmup_steps", "warmup_steps", "warmup_iter")),
+        ("lr_decay_steps", ("lr_decay_steps", "decay_steps", "end_iter")),
+        ("lr_decay_style", ("lr_decay_style", "decay_style")),
+    )
+    _WD_FIELDS = (
+        ("start_wd", ("start_wd",)),
+        ("end_wd", ("end_wd",)),
+        ("wd_incr_steps", ("wd_incr_steps",)),
+        ("wd_incr_style", ("wd_incr_style",)),
+    )
 
-    def _check_and_set(self, cls_value, sd_value, name: str):
+    def state_dict(self) -> dict:
+        fields = [a for a, _keys in self._LR_FIELDS + self._WD_FIELDS]
+        return {a: getattr(self, a) for a in fields} | {
+            "num_steps": self.num_steps}
+
+    def _restore_field(self, attr: str, keys) -> None:
+        """Adopt the checkpointed value for one field, honoring the
+        override/constancy policy flags."""
+        found = next((state for k in keys
+                      if (state := self._loading.get(k)) is not None), None)
         if self.override_opt_param_scheduler:
-            logger.info("overriding %s value to %s", name, cls_value)
-            return cls_value
-        if not self.use_checkpoint_opt_param_scheduler:
-            assert cls_value == sd_value, (
-                f"OptimizerParamScheduler: class input value {cls_value} and "
-                f"checkpoint value {sd_value} for {name} do not match")
-        return sd_value
+            logger.info("scheduler restore: keeping constructor %s=%r",
+                        attr, getattr(self, attr))
+            return
+        if found is None:
+            raise KeyError(
+                f"scheduler restore: checkpoint carries none of {keys} "
+                f"for field {attr!r}")
+        current = getattr(self, attr)
+        if not self.use_checkpoint_opt_param_scheduler and current != found:
+            raise ValueError(
+                f"scheduler restore: {attr} changed between run config "
+                f"({current!r}) and checkpoint ({found!r}); pass "
+                "use_checkpoint_opt_param_scheduler=true to adopt the "
+                "checkpoint, or override_opt_param_scheduler=true to keep "
+                "the config")
+        setattr(self, attr, found)
 
     def load_state_dict(self, state_dict: dict) -> None:
-        # Legacy Megatron key aliases handled for parity
-        # (reference optim/scheduler.py:260-313).
-        max_lr_ = state_dict.get("start_lr", state_dict.get("max_lr"))
-        self.max_lr = self._check_and_set(self.max_lr, max_lr_, "learning rate")
-        self.min_lr = self._check_and_set(
-            self.min_lr, state_dict["min_lr"], "minimum learning rate")
-        warm = state_dict.get(
-            "warmup_iter", state_dict.get("warmup_steps",
-                                          state_dict.get("lr_warmup_steps")))
-        self.lr_warmup_steps = self._check_and_set(
-            self.lr_warmup_steps, warm, "warmup iterations")
-        decay = state_dict.get(
-            "end_iter", state_dict.get("decay_steps",
-                                       state_dict.get("lr_decay_steps")))
-        self.lr_decay_steps = self._check_and_set(
-            self.lr_decay_steps, decay, "total number of iterations")
-        style = state_dict.get("decay_style", state_dict.get("lr_decay_style"))
-        self.lr_decay_style = self._check_and_set(
-            self.lr_decay_style, style, "learning rate decay style")
+        self._loading = dict(state_dict)
+        try:
+            for attr, keys in self._LR_FIELDS:
+                self._restore_field(attr, keys)
+            # wd fields only exist in checkpoints that scheduled wd
+            if "start_wd" in state_dict:
+                for attr, keys in self._WD_FIELDS:
+                    self._restore_field(attr, keys)
+        finally:
+            del self._loading
         self.num_steps = 0
-        self.step(state_dict.get("num_iters", state_dict.get("num_steps", 0)))
-        if "start_wd" in state_dict:
-            self.start_wd = self._check_and_set(
-                self.start_wd, state_dict["start_wd"], "start weight decay")
-            self.end_wd = self._check_and_set(
-                self.end_wd, state_dict["end_wd"], "end weight decay")
-            self.wd_incr_steps = self._check_and_set(
-                self.wd_incr_steps, state_dict["wd_incr_steps"],
-                "total number of weight decay iterations")
-            self.wd_incr_style = self._check_and_set(
-                self.wd_incr_style, state_dict["wd_incr_style"],
-                "weight decay incr style")
+        self.step(state_dict.get("num_steps", state_dict.get("num_iters", 0)))

@@ -175,3 +175,57 @@ def test_dataloader_iterable(tmp_path):
     dl = StatefulDataLoader(ds, batch_size=4, shuffle=False)
     batches = list(dl)
     assert batches[0]["input_ids"].shape == (4, 32)
+
+
+def test_mock_packed_fixed_blocks():
+    from automodel_tpu.datasets.llm.mock_packed import build_packed_dataset
+
+    ds = build_packed_dataset(num_blocks=6, block_size=32, vocab_size=50,
+                              seed=3)
+    assert len(ds) == 6
+    for ex in ds:
+        assert len(ex["input_ids"]) == 32
+        assert len(ex["position_ids"]) == 32
+        assert ex["labels"] == ex["input_ids"]
+        # position ids restart after eos
+        for i in range(1, 32):
+            if ex["input_ids"][i - 1] == 1:
+                assert ex["position_ids"][i] == 0
+    # deterministic under the same seed
+    again = build_packed_dataset(num_blocks=6, block_size=32, vocab_size=50,
+                                 seed=3)
+    assert again == ds
+
+
+def test_nanogpt_data_processor_tool(tmp_path):
+    import json
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        from nanogpt_data_processor import ShardWriter, parse_token_count
+    finally:
+        sys.path.pop(0)
+
+    assert parse_token_count("500M") == 500_000_000
+    assert parse_token_count("2K") == 2000
+    assert parse_token_count(123) == 123
+    assert parse_token_count(None) == 0
+
+    import numpy as np
+
+    from automodel_tpu.datasets.llm.nanogpt_dataset import load_shard
+
+    w = ShardWriter(str(tmp_path), shard_size=100, prefix="t")
+    rng = np.random.default_rng(0)
+    all_tokens = []
+    for _ in range(7):
+        t = rng.integers(0, 50000, 37).astype(np.uint32)
+        all_tokens.append(t)
+        w.add(t)
+    w.finalize()
+    flat = np.concatenate(all_tokens)
+    out = np.concatenate([np.asarray(load_shard(p)) for p in w.shard_paths])
+    np.testing.assert_array_equal(out, flat)
+    assert all(len(np.asarray(load_shard(p))) == 100
+               for p in w.shard_paths[:-1])

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Tokenize an HF text dataset into memory-mapped .bin shards.
+
+Produces the shard format ``automodel_tpu.datasets.llm.nanogpt_dataset``
+streams (MAGIC/VERSION/int32 header + uint16/uint32 tokens) — the TPU
+equivalent of the reference's FineWeb preprocessor
+(``/root/reference/tools/nanogpt_data_processor.py:1``), reduced to the
+pieces the training path needs: load dataset (hub id or local files),
+tokenize with an HF tokenizer (BOS-prefixed documents), write fixed-size
+shards plus a ``meta.json``.
+
+Usage:
+    python tools/nanogpt_data_processor.py \
+        --dataset HuggingFaceFW/fineweb --set-name sample-10BT \
+        --output-dir data/fineweb --max-tokens 500M
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+
+def parse_token_count(value: str | int | None) -> int:
+    """'500M' / '1B' / '250K' / plain ints -> token count (0 = unlimited)."""
+    if value is None:
+        return 0
+    if isinstance(value, int):
+        return value
+    s = value.strip().upper()
+    mult = {"K": 10**3, "M": 10**6, "B": 10**9}.get(s[-1:], None)
+    return int(float(s[:-1]) * mult) if mult else int(s)
+
+
+def iter_documents(args):
+    from datasets import load_dataset
+
+    kwargs = {"split": args.split, "streaming": args.streaming}
+    if args.set_name:
+        kwargs["name"] = args.set_name
+    ds = load_dataset(args.dataset, **kwargs)
+    for row in ds:
+        text = row.get(args.text_column)
+        if text:
+            yield text
+
+
+class ShardWriter:
+    """Accumulates tokens and flushes ``shard_size``-token .bin files."""
+
+    def __init__(self, output_dir: str, shard_size: int, prefix: str):
+        from automodel_tpu.datasets.llm.nanogpt_dataset import write_shard
+
+        self._write_shard = write_shard
+        self.output_dir = output_dir
+        self.shard_size = shard_size
+        self.prefix = prefix
+        self.buffer: list[np.ndarray] = []
+        self.buffered = 0
+        self.shard_paths: list[str] = []
+        os.makedirs(output_dir, exist_ok=True)
+
+    def add(self, tokens: np.ndarray) -> None:
+        self.buffer.append(tokens)
+        self.buffered += len(tokens)
+        while self.buffered >= self.shard_size:
+            flat = np.concatenate(self.buffer)
+            self._flush(flat[:self.shard_size])
+            rest = flat[self.shard_size:]
+            self.buffer, self.buffered = [rest], len(rest)
+
+    def finalize(self) -> None:
+        if self.buffered:
+            self._flush(np.concatenate(self.buffer))
+            self.buffer, self.buffered = [], 0
+
+    def _flush(self, tokens: np.ndarray) -> None:
+        path = os.path.join(
+            self.output_dir,
+            f"{self.prefix}_{len(self.shard_paths):06d}.bin")
+        self._write_shard(path, tokens)
+        self.shard_paths.append(path)
+        print(f"wrote {path} ({len(tokens):,} tokens)")
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--dataset", required=True,
+                   help="HF hub id or local dataset path")
+    p.add_argument("--set-name", default=None, help="HF config name")
+    p.add_argument("--split", default="train")
+    p.add_argument("--text-column", default="text")
+    p.add_argument("--tokenizer", default="gpt2",
+                   help="HF tokenizer id (resolved from the local cache)")
+    p.add_argument("--output-dir", default="data")
+    p.add_argument("--shard-size", type=parse_token_count, default="100M",
+                   help="tokens per shard (e.g. 100M)")
+    p.add_argument("--max-tokens", type=parse_token_count, default=0,
+                   help="stop after this many tokens (0 = all)")
+    p.add_argument("--streaming", action="store_true", default=False)
+    args = p.parse_args(argv)
+
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(args.tokenizer)
+    bos_id = tok.bos_token_id if tok.bos_token_id is not None else (
+        tok.eos_token_id)
+
+    writer = ShardWriter(args.output_dir, args.shard_size,
+                         prefix=os.path.basename(args.dataset).replace("/", "-"))
+    total = 0
+    for text in iter_documents(args):
+        ids = tok(text, add_special_tokens=False)["input_ids"]
+        tokens = np.asarray([bos_id] + ids, dtype=np.uint32)
+        writer.add(tokens)
+        total += len(tokens)
+        if args.max_tokens and total >= args.max_tokens:
+            break
+    writer.finalize()
+
+    meta = {
+        "dataset": args.dataset,
+        "tokenizer": args.tokenizer,
+        "bos_token_id": int(bos_id),
+        "total_tokens": int(total),
+        "shards": [os.path.basename(s) for s in writer.shard_paths],
+    }
+    with open(os.path.join(args.output_dir, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    print(f"done: {total:,} tokens in {len(writer.shard_paths)} shards")
+
+
+if __name__ == "__main__":
+    main()
