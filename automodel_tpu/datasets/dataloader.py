@@ -37,10 +37,24 @@ class StatefulDataLoader:
         seed: int = 0,
         drop_last: bool = True,
         pad_seq_len_divisible: Optional[int] = None,
+        host_rows: Optional[Any] = None,
         **_unused,
     ) -> None:
+        """``host_rows``: per-host input sharding — indices INTO each global
+        batch that this host materializes (from ``distributed.shardings.
+        process_batch_rows``).  The epoch permutation stays global and
+        seed-shared, so hosts agree on which sample occupies which row and
+        each host only tokenizes/collates its own dp slice (reference:
+        per-rank StatefulDistributedSampler, ``train_ft.py:283-307``)."""
         self.dataset = dataset
         self.batch_size = int(batch_size)
+        self.host_rows = (None if host_rows is None
+                          else np.asarray(host_rows, np.int64))
+        if self.host_rows is not None and not drop_last:
+            # a truncated final global batch would slice differently per
+            # host (and could not satisfy the dp sharding anyway)
+            raise ValueError(
+                "host_rows (per-host input sharding) requires drop_last=True")
         if collate_fn is None:
             collate_fn = default_collater
         self.collate_fn = collate_fn
@@ -84,7 +98,9 @@ class StatefulDataLoader:
             while i + self.batch_size <= n or (
                     not self.drop_last and i < n):
                 idxs = order[i:i + self.batch_size]
-                samples = [dict(self.dataset[int(j)]) for j in idxs]
+                take = idxs if self.host_rows is None else (
+                    idxs[self.host_rows[self.host_rows < len(idxs)]])
+                samples = [dict(self.dataset[int(j)]) for j in take]
                 i += len(idxs)
                 # Update state BEFORE yielding: a checkpoint taken after
                 # consuming this batch resumes at the next one, and an
@@ -104,16 +120,22 @@ class StatefulDataLoader:
             skip = self._index
             for _ in range(skip):
                 next(it, None)
+            def local(batch):
+                if self.host_rows is None:
+                    return batch
+                keep = self.host_rows[self.host_rows < len(batch)]
+                return [batch[int(r)] for r in keep]
+
             batch = []
             for sample in it:
                 batch.append(dict(sample))
                 if len(batch) == self.batch_size:
                     self._index += self.batch_size
-                    yield self._collate(batch)
+                    yield self._collate(local(batch))
                     batch = []
             if batch and not self.drop_last:
                 self._index += len(batch)
-                yield self._collate(batch)
+                yield self._collate(local(batch))
             self._index = 0
             self.epoch += 1
 

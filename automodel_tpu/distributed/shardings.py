@@ -152,6 +152,31 @@ def batch_shardings(mesh: Mesh, batch: Optional[Any] = None) -> Any:
     return jax.tree.map(lambda _: sh, batch)
 
 
+def batch_rows_by_process(mesh: Mesh, global_batch: int):
+    """{process index: sorted row indices} of the global batch dim under the
+    dp sharding — which rows each HOST must materialize.
+
+    The per-host input pipeline (reference: per-rank
+    StatefulDistributedSampler, ``recipes/llm/train_ft.py:283-307``) feeds
+    each host only its own dp slice; this mapping is derived from the mesh's
+    own device->index map, so it is correct for any dp/cp/tp layout and any
+    host->device assignment.
+    """
+    import numpy as np
+
+    sh = NamedSharding(mesh, P((AXIS_DP_REPLICATE, AXIS_DP_SHARD)))
+    by_proc: dict = {}
+    for dev, idx in sh.devices_indices_map((global_batch,)).items():
+        rows = by_proc.setdefault(dev.process_index, set())
+        rows.update(range(*idx[0].indices(global_batch)))
+    return {p: np.array(sorted(r), np.int64) for p, r in by_proc.items()}
+
+
+def process_batch_rows(mesh: Mesh, global_batch: int):
+    """This host's rows of the global batch (see batch_rows_by_process)."""
+    return batch_rows_by_process(mesh, global_batch)[jax.process_index()]
+
+
 # ---------------------------------------------------------------------------
 # Optimizer / auxiliary state sharding by structural matching
 # ---------------------------------------------------------------------------

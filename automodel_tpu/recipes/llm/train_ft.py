@@ -95,7 +95,8 @@ def build_dataset(cfg_ds: ConfigNode, tokenizer=None):
 
 
 def build_dataloader(cfg: ConfigNode, dataset, cfg_key: str = "dataloader",
-                     local_batch_size: int = 1, seed: int = 0):
+                     local_batch_size: int = 1, seed: int = 0,
+                     host_rows=None):
     """Dataset (+ optional packing) -> StatefulDataLoader.
 
     Reference ``build_dataloader`` (``train_ft.py:226-307``): PackedSequence
@@ -117,6 +118,8 @@ def build_dataloader(cfg: ConfigNode, dataset, cfg_key: str = "dataloader",
                   if k not in ("_target_",)}
     kwargs.setdefault("batch_size", local_batch_size)
     kwargs.setdefault("seed", seed)
+    if host_rows is not None:
+        kwargs.setdefault("host_rows", host_rows)
     target = dl_cfg.get("_target_") if isinstance(dl_cfg, ConfigNode) else None
     if target:
         from automodel_tpu.config.loader import resolve_target
@@ -346,29 +349,65 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         cfg = self.cfg
         self.tokenizer = build_tokenizer(cfg, self.model)
         dataset = build_dataset(cfg.get("dataset"), tokenizer=self.tokenizer)
+        # Per-host input sharding: on a multi-host mesh each host tokenizes
+        # and collates only its own dp rows of every global microbatch
+        # (reference: per-rank sampler, ``train_ft.py:283-307``); the shared
+        # permutation seed keeps hosts agreed on row contents.
+        self._host_rows = None
+        if jax.process_count() > 1:
+            from automodel_tpu.distributed.shardings import process_batch_rows
+
+            self._host_rows = process_batch_rows(
+                self.mesh_manager.mesh, global_mb)
+            packed = cfg.get("packed_sequence.packed_sequence_size", 0)
+            if not packed and cfg.get("dataset.seq_length") is None:
+                logger.warning(
+                    "per-host input sharding with variable-length rows: "
+                    "hosts must collate to identical [B_local, S] shapes — "
+                    "set packed_sequence.packed_sequence_size or "
+                    "dataset.seq_length to guarantee a fixed S")
         self.dataloader = build_dataloader(
             cfg, dataset, "dataloader",
-            local_batch_size=global_mb, seed=self.rng.seed)
+            local_batch_size=global_mb, seed=self.rng.seed,
+            host_rows=self._host_rows)
         self.val_dataloader = None
         if cfg.get("validation_dataset") is not None:
             val_ds = build_dataset(cfg.get("validation_dataset"),
                                    tokenizer=self.tokenizer)
+            # Bucket val sequence lengths to multiples of 128: every distinct
+            # [B, S] shape is a fresh XLA compile of eval_step, and unpadded
+            # val batches recompile per batch (VERDICT weak #9).
+            if "validation_dataloader.pad_seq_len_divisible" not in cfg:
+                cfg.set_by_dotted(
+                    "validation_dataloader.pad_seq_len_divisible", 128)
+            # Validation stays on the GLOBAL loader even when training input
+            # is host-sharded: with variable-length rows each host would pad
+            # its local slice to a different S and the global [B, S] could
+            # not be assembled; val sets are small, so the global collate
+            # cost is irrelevant.
             self.val_dataloader = build_dataloader(
                 cfg, val_ds, "validation_dataloader",
                 local_batch_size=global_mb, seed=self.rng.seed)
 
     # -- hot loop ----------------------------------------------------------
     def _device_batch(self, batches: List[Dict[str, np.ndarray]],
-                      train: bool = True):
+                      train: bool = True,
+                      process_local: Optional[bool] = None):
+        if process_local is None:
+            process_local = getattr(self, "_host_rows", None) is not None
         stacked = stack_microbatches(batches)
         stacked.pop("loss_mask", None)  # already folded into labels
         if train and getattr(self.model, "wants_dropout_rng", False):
-            # One fresh rng per microbatch (LoRA dropout); key data rides the
+            # One rng per microbatch (LoRA dropout); derived from (seed,
+            # optimizer step) — NOT the ranked per-host stream — so every
+            # host agrees on the replicated key data, and key data rides the
             # batch so the jitted step stays rng-free state-wise.
+            step_key = jax.random.fold_in(
+                jax.random.key(self.rng.seed), self.step_scheduler.step)
             stacked["dropout_rng"] = np.stack([
-                np.asarray(jax.random.key_data(self.rng.next_key()))
-                for _ in range(len(batches))])
-        return self.step_fns.shard_batch(stacked)
+                np.asarray(jax.random.key_data(k))
+                for k in jax.random.split(step_key, len(batches))])
+        return self.step_fns.shard_batch(stacked, process_local=process_local)
 
     def _run_train_optim_step(self, batches: List[Dict[str, np.ndarray]]):
         """Dispatch one optimizer step and return metrics WITHOUT stalling
@@ -448,7 +487,9 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             return None
         total_loss, total_tokens = 0.0, 0
         for vb in self.val_dataloader:
-            batch = self._device_batch([vb], train=False)
+            # val batches are global on every host (see _setup_data)
+            batch = self._device_batch([vb], train=False,
+                                       process_local=False)
             m = self.step_fns.eval_step(self.params, batch)
             n = int(m["num_label_tokens"])
             total_loss += float(m["loss"]) * max(n, 1)

@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
@@ -68,12 +69,18 @@ class TrainStepFns:
     opt_state_sharding: Any
     microbatch_sharding: Any
 
-    def shard_batch(self, stacked: Dict[str, Any]) -> Dict[str, Any]:
+    def shard_batch(self, stacked: Dict[str, Any],
+                    process_local: bool = False) -> Dict[str, Any]:
         """Place a stacked microbatch dict on the mesh with per-key specs:
         [A, B, S] token arrays get the dp x cp batch sharding; pixel_values
         [A, B_img, H, W, C] shard the image-batch dim over dp only (images
         have no sequence dim to context-parallelize); anything else is
-        replicated."""
+        replicated.
+
+        ``process_local``: [A, B_local, S] arrays hold only THIS host's dp
+        rows (per-host input pipeline) — assembled into global arrays via
+        ``make_array_from_process_local_data`` instead of ``device_put``.
+        Replicated leaves must be host-invariant either way."""
         if self.microbatch_sharding is None:
             return stacked
         mesh = self.microbatch_sharding.mesh
@@ -93,10 +100,16 @@ class TrainStepFns:
             if key == "pixel_values":
                 # Image counts are data-dependent (multi-image conversations);
                 # fall back to replication when the dp split doesn't divide.
+                assert not process_local, (
+                    "per-host input sharding does not support pixel_values; "
+                    "use the global loader for VLM runs")
                 if v.shape[1] % axis_size(spec[1]) == 0:
                     return jax.device_put(v, pixel_sharding)
                 return jax.device_put(v, rep)
             if getattr(v, "ndim", 0) == 3:
+                if process_local:
+                    return jax.make_array_from_process_local_data(
+                        self.microbatch_sharding, np.asarray(v))
                 return jax.device_put(v, self.microbatch_sharding)
             return jax.device_put(v, rep)
 
@@ -118,7 +131,16 @@ def build_train_step(
     reference's microbatch loop + sync ctx (``train_ft.py:653-684``).
     """
     loss_fn = loss_fn if loss_fn is not None else MaskedCrossEntropy()
-    if getattr(loss_fn, "reduction", "sum") != "sum":
+    # Loss contract (typed, not by accident): a loss object must carry
+    # ``reduction`` and ``needs_hidden`` attributes; this step normalizes by
+    # the global label-token count itself, so only sum-reduction losses fit.
+    for attr in ("reduction", "needs_hidden"):
+        if not hasattr(loss_fn, attr):
+            raise TypeError(
+                f"loss_fn {type(loss_fn).__name__} does not satisfy the loss "
+                f"contract: missing attribute {attr!r} (see "
+                "automodel_tpu/loss/*.py for conforming implementations)")
+    if loss_fn.reduction != "sum":
         raise ValueError(
             "build_train_step normalizes by the global label-token count "
             "itself; configure the loss with reduction='sum' (got "
