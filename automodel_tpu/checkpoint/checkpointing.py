@@ -44,6 +44,9 @@ class CheckpointingConfig:
     is_peft: bool = False
     model_cache_dir: Optional[str] = None
     model_repo_id: Optional[str] = None
+    # Parallel per-process shard writes for consolidated exports; set false
+    # when the checkpoint dir is NOT a shared filesystem (host 0 writes all).
+    distribute_writes: bool = True
 
     def __post_init__(self):
         if isinstance(self.model_save_format, CheckpointFormat):
@@ -104,11 +107,19 @@ def save_model(model, params: Any, weights_path: str,
 
         save_adapters(model, params, weights_path, peft_config)
         return
-    if config.model_save_format == "safetensors":
-        from automodel_tpu.models.hf_io import save_hf_weights
+    if config.model_save_format == "safetensors" and config.save_consolidated:
+        # Consolidated HF repo: collective gathers, shard files written in
+        # parallel (one per process, round-robin), tokenizer/generation
+        # sidecars copied so the export is a complete standalone repo.
+        from automodel_tpu.models.hf_io import copy_hf_aux_files, save_hf_weights
 
-        save_hf_weights(model, params, weights_path)
+        save_hf_weights(model, params, weights_path,
+                        distribute_writes=config.distribute_writes)
+        copy_hf_aux_files(getattr(model, "checkpoint_dir", None), weights_path)
     else:
+        # Non-consolidated: Orbax writes each host's own shards — no gather
+        # at all (the reference's per-rank DCP sharded save role,
+        # ``_backports/hf_storage.py:67``).
         save_pytree(os.path.join(weights_path, "orbax"), params)
 
 
@@ -118,7 +129,16 @@ def load_model(model, weights_path: str,
     """Parallel load into (sharded) device arrays — the meta-device-init
     equivalent: abstract-eval first, stream only needed byte ranges."""
     config = config or CheckpointingConfig()
-    if config.model_save_format == "safetensors":
+    if config.model_save_format == "safetensors" and config.save_consolidated:
+        has_hf_repo = os.path.exists(
+            os.path.join(weights_path, "model.safetensors.index.json")
+        ) or os.path.exists(os.path.join(weights_path, "model.safetensors"))
+        if not has_hf_repo:
+            raise FileNotFoundError(
+                f"{weights_path} has no model.safetensors[.index.json]; the "
+                "config expects a consolidated safetensors checkpoint "
+                "(interrupted save, wrong path, or a non-shared filesystem "
+                "where another host wrote the shards?)")
         from automodel_tpu.models.hf_io import load_hf_weights
 
         return load_hf_weights(model, weights_path, shardings=shardings)

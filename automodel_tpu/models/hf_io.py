@@ -285,19 +285,24 @@ def save_hf_weights(
     out_dir: str,
     max_shard_bytes: int = 5 * 1024**3,
     save_dtype: Optional[Any] = None,
+    distribute_writes: bool = True,
 ) -> None:
     """Write params as a consolidated HF safetensors repo (+ index + config.json).
 
-    Only process 0 writes (params are fully addressable after an
-    all-gather-on-read of each leaf).  Mirrors the reference's consolidation
-    output (``checkpoint/_backports/consolidate_hf_safetensors.py:794``).
+    Multi-host: the shard plan is deterministic from shapes alone, so every
+    process computes it identically and **each shard file is written by a
+    different process** (round-robin) — write bandwidth scales with hosts
+    instead of funnelling the whole model through host 0 (the reference's
+    per-rank writer idea, ``checkpoint/_backports/hf_storage.py:67``, applied
+    to the consolidated layout).  Gathers remain collective; process 0 writes
+    the index.  ``distribute_writes=False`` restores the host-0-only writer
+    (e.g. when only host 0 sees the output filesystem).
     """
     from safetensors.numpy import save_file
 
     key_map = _key_map_for(model)
     flat = _flatten(params)
     save_dtype = np.dtype(save_dtype) if save_dtype is not None else None
-    is_writer = jax.process_index() == 0
 
     def materialize(v) -> np.ndarray:
         # Cross-host-sharded leaves need a collective gather that EVERY
@@ -351,12 +356,20 @@ def save_hf_weights(
         shard_plan[-1].append((name, fn))
         cur_bytes += nbytes
 
-    if is_writer:
+    proc, nproc = jax.process_index(), jax.process_count()
+    # every writing process creates the dir on ITS filesystem (the output
+    # path need not be shared; the index then only covers host-0 files, so
+    # non-shared setups should pass distribute_writes=False)
+    if proc == 0 or distribute_writes:
         os.makedirs(out_dir, exist_ok=True)
+    if nproc > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("hf_save_dir_ready")
 
     # Materialize and write one shard at a time: peak host RAM is one shard,
     # not the whole model.  All processes run the loop (the gathers are
-    # collective); only process 0 keeps arrays and writes files.
+    # collective); shard i is kept + written by process i % nproc.
     n = len(shard_plan)
     weight_map: Dict[str, str] = {}
     total = 0
@@ -365,24 +378,56 @@ def save_hf_weights(
             "model.safetensors" if n == 1
             else f"model-{i + 1:05d}-of-{n:05d}.safetensors"
         )
+        writes_this = (i % nproc == proc) if distribute_writes else (proc == 0)
         shard: Dict[str, np.ndarray] = {}
         for name, fn in shard_entries:
             arr = fn()
-            if is_writer:
+            # the index is deterministic from the plan — track it everywhere
+            weight_map[name] = fname
+            total += arr.nbytes
+            if writes_this:
                 shard[name] = arr
-                weight_map[name] = fname
-                total += arr.nbytes
-        if is_writer:
+        if writes_this:
             save_file(shard, os.path.join(out_dir, fname),
                       metadata={"format": "pt"})
         del shard
-    if not is_writer:
+    if nproc > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("hf_save_shards_done")
+    if proc != 0:
         return
     with open(os.path.join(out_dir, SAFETENSORS_INDEX), "w") as f:
         json.dump(
             {"metadata": {"total_size": total}, "weight_map": weight_map},
             f, indent=2)
     save_hf_config(model, out_dir)
+
+
+# Tokenizer / generation-config sidecar files a complete HF repo carries
+# (reference copies them into consolidated exports, ``checkpointing.py:240``).
+HF_AUX_FILES = (
+    "tokenizer.json", "tokenizer_config.json", "special_tokens_map.json",
+    "tokenizer.model", "vocab.json", "merges.txt", "generation_config.json",
+    "preprocessor_config.json", "processor_config.json", "chat_template.json",
+)
+
+
+def copy_hf_aux_files(src_dir: Optional[str], out_dir: str) -> List[str]:
+    """Copy tokenizer/processor/generation files from the source checkpoint
+    into an exported repo so it is loadable end-to-end (AutoTokenizer +
+    AutoModel) without the original.  Process 0 only; missing files skip."""
+    import shutil
+
+    if src_dir is None or jax.process_index() != 0:
+        return []
+    copied = []
+    for name in HF_AUX_FILES:
+        src = os.path.join(src_dir, name)
+        if os.path.isfile(src):
+            shutil.copy2(src, os.path.join(out_dir, name))
+            copied.append(name)
+    return copied
 
 
 def save_hf_config(model, out_dir: str) -> None:

@@ -59,3 +59,56 @@ def test_transformers_cross_load(model, tmp_path):
     w = hf_model.model.layers[0].mlp.gate_proj.weight.detach().numpy()
     ours = np.asarray(params["layers"]["mlp"]["gate_proj"]["kernel"][0]).T
     np.testing.assert_array_equal(w.astype(np.float32), ours.astype(np.float32))
+
+
+def test_aux_files_copied_into_export(model, tmp_path):
+    from automodel_tpu.checkpoint.checkpointing import (
+        CheckpointingConfig,
+        save_model,
+    )
+
+    src = tmp_path / "src_ckpt"
+    src.mkdir()
+    (src / "tokenizer.json").write_text("{}")
+    (src / "tokenizer_config.json").write_text("{}")
+    (src / "generation_config.json").write_text("{}")
+    (src / "pytorch_model.bin").write_text("not copied")
+    model.checkpoint_dir = str(src)
+
+    out = tmp_path / "export"
+    params = model.init(jax.random.key(0))
+    save_model(model, params, str(out),
+               CheckpointingConfig(model_save_format="safetensors",
+                                   save_consolidated=True))
+    for name in ("tokenizer.json", "tokenizer_config.json",
+                 "generation_config.json", "config.json",
+                 "model.safetensors.index.json"):
+        assert (out / name).exists(), name
+    assert not (out / "pytorch_model.bin").exists()
+
+
+def test_nonconsolidated_save_roundtrips_via_orbax(model, tmp_path):
+    from automodel_tpu.checkpoint.checkpointing import (
+        CheckpointingConfig,
+        load_model,
+        save_model,
+    )
+    from automodel_tpu.distributed.mesh import MeshManager
+    from automodel_tpu.distributed.shardings import build_parallel_plan
+
+    mm = MeshManager(dp_size=4, tp_size=2)
+    plan = build_parallel_plan(model, mm)
+    params = plan.shard_params(model.init(jax.random.key(1)))
+    cfg = CheckpointingConfig(model_save_format="safetensors",
+                              save_consolidated=False)
+    out = tmp_path / "ckpt"
+    save_model(model, params, str(out), cfg)
+    assert (out / "orbax").exists()          # no HF gather happened
+    assert not (out / "model.safetensors.index.json").exists()
+
+    restored = load_model(model, str(out), cfg, shardings=plan.param_sharding)
+    diffs = jax.tree.map(
+        lambda a, b: float(np.max(np.abs(
+            np.asarray(a, np.float32) - np.asarray(b, np.float32)))),
+        restored, params)
+    assert max(jax.tree.leaves(diffs)) == 0.0
