@@ -18,6 +18,7 @@ from automodel_tpu.loss.masked_ce import IGNORE_INDEX, cross_entropy_sum
 
 class ChunkedCrossEntropy:
     needs_hidden = False
+    reduction = "sum"  # framework loss contract: see training/train_step.py
 
     def __init__(self, chunk_len: int = 32, ignore_index: int = IGNORE_INDEX):
         assert ignore_index == IGNORE_INDEX

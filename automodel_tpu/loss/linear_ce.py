@@ -24,6 +24,7 @@ from automodel_tpu.loss.masked_ce import IGNORE_INDEX
 
 class FusedLinearCrossEntropy:
     needs_hidden = True
+    reduction = "sum"  # framework loss contract: see training/train_step.py
 
     def __init__(self, chunk_len: int = 512, ignore_index: int = IGNORE_INDEX):
         assert ignore_index == IGNORE_INDEX

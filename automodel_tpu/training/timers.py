@@ -103,11 +103,51 @@ class Timers:
             for n in names if n in self._timers
         }
 
+    def get_global_elapsed(self, names: List[str],
+                           reset: bool = True, normalizer: float = 1.0
+                           ) -> Dict[str, Dict[str, float]]:
+        """Cross-host timer stats {name: {min, max, mean}} (the reference's
+        minmax/all rank reports, ``timers.py:257-404``).  COLLECTIVE when
+        process_count > 1: every host must call it, with the SAME explicit
+        ``names`` list — a host that never started one of the timers simply
+        contributes 0 for it (per-host timer sets may differ)."""
+        local = self.get_elapsed(names, reset=reset, normalizer=normalizer)
+        keys = list(names)
+        values = np.asarray([local.get(k, 0.0) for k in keys], np.float32)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            all_values = np.asarray(
+                multihost_utils.process_allgather(values))  # [P, N]
+        else:
+            all_values = values[None]
+        return {
+            k: {"min": float(all_values[:, i].min()),
+                "max": float(all_values[:, i].max()),
+                "mean": float(all_values[:, i].mean())}
+            for i, k in enumerate(keys)
+        }
+
     def log(self, names: Optional[List[str]] = None, reset: bool = True,
-            normalizer: float = 1.0, logger=None) -> str:
-        elapsed = self.get_elapsed(names, reset=reset, normalizer=normalizer)
-        msg = "time (ms)" + "".join(
-            f" | {n}: {v * 1000.0:.2f}" for n, v in elapsed.items())
+            normalizer: float = 1.0, logger=None,
+            cross_host: bool = False) -> str:
+        """``cross_host=True`` reports (min, max) across hosts — COLLECTIVE:
+        every process must make the identical call (do NOT gate it on
+        is_main, that deadlocks the others); requires explicit ``names``.
+        The default stays host-local and safe to call from any subset of
+        ranks."""
+        if cross_host and jax.process_count() > 1:
+            assert names is not None, "cross_host log needs explicit names"
+            stats = self.get_global_elapsed(names, reset=reset,
+                                            normalizer=normalizer)
+            msg = "time (ms, cross-host)" + "".join(
+                f" | {n}: ({s['min'] * 1e3:.2f}, {s['max'] * 1e3:.2f})"
+                for n, s in stats.items())
+        else:
+            elapsed = self.get_elapsed(names, reset=reset,
+                                       normalizer=normalizer)
+            msg = "time (ms)" + "".join(
+                f" | {n}: {v * 1000.0:.2f}" for n, v in elapsed.items())
         if logger is not None:
             logger.info(msg)
         return msg

@@ -1,0 +1,74 @@
+"""TP loss parity: the vocab-parallel CE question (VERDICT missing #5).
+
+The reference ships a vocab-parallel CE with Triton kernels
+(``components/loss/te_parallel_ce.py:35,101``) because torch TP shards the
+lm_head over ranks and eager code must psum partial logsumexps by hand.
+Under GSPMD the same program is written once and the compiler inserts the
+collectives: the fused-linear CE's chunk matmul against a tp-sharded
+lm_head kernel IS the vocab-parallel CE.  These tests pin that equivalence:
+identical loss AND identical gradients on tp=1 vs tp=2 meshes, for both
+the full-logits and the fused-linear paths.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.distributed.mesh import MeshManager
+from automodel_tpu.distributed.shardings import build_parallel_plan
+from automodel_tpu.loss.linear_ce import FusedLinearCrossEntropy
+from automodel_tpu.loss.masked_ce import MaskedCrossEntropy
+from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from automodel_tpu.optim import build_optimizer
+from automodel_tpu.training.train_step import build_train_step
+
+
+def _model():
+    return LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, tie_word_embeddings=False), remat=False,
+        compute_dtype=jnp.float32)
+
+
+def _batch():
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 255, (1, 8, 16)).astype(np.int32)
+    labels = np.roll(ids, -1, -1).copy()
+    labels[..., -1] = -100
+    labels[:, :, :3] = -100  # prompt masking exercises the valid-token path
+    return {"input_ids": ids, "labels": labels}
+
+
+def _loss_and_grads(loss_fn, dp, tp):
+    model = _model()
+    mm = MeshManager(dp_size=dp, tp_size=tp, sequence_parallel=tp > 1)
+    plan = build_parallel_plan(model, mm)
+    # momentum-free SGD at lr=1: the post-step param delta IS the (negated)
+    # gradient, so comparing params compares gradients without Adam's
+    # rounding-amplifying normalization.
+    tx = build_optimizer(name="sgd", lr=1.0, momentum=0.0, weight_decay=0.0)
+    fns = build_train_step(model, tx, loss_fn=loss_fn, plan=plan)
+    params = plan.shard_params(model.init(jax.random.key(0)))
+    opt = fns.init_opt_state(params)
+    batch = fns.shard_batch(dict(_batch()))
+    new_params, _, m = fns.train_step(params, opt, batch)
+    return float(m["loss"]), jax.tree.map(
+        lambda a: np.asarray(a, np.float32), new_params)
+
+
+@pytest.mark.parametrize("loss_fn_cls", [
+    MaskedCrossEntropy, lambda: FusedLinearCrossEntropy(chunk_len=8)])
+def test_loss_and_update_identical_tp1_vs_tp2(loss_fn_cls):
+    l1, p1 = _loss_and_grads(loss_fn_cls(), dp=8, tp=1)
+    l2, p2 = _loss_and_grads(loss_fn_cls(), dp=4, tp=2)
+    assert l1 == pytest.approx(l2, rel=1e-5)
+    diffs = jax.tree.map(lambda a, b: float(np.max(np.abs(a - b))), p1, p2)
+    assert max(jax.tree.leaves(diffs)) < 1e-5
+
+
+def test_fused_equals_full_logits_loss():
+    lf, _ = _loss_and_grads(FusedLinearCrossEntropy(chunk_len=8), dp=4, tp=2)
+    lm, _ = _loss_and_grads(MaskedCrossEntropy(), dp=4, tp=2)
+    assert lf == pytest.approx(lm, rel=1e-5)
