@@ -1,0 +1,3 @@
+from automodel_tpu.generation.generate import GenerationConfig, generate
+
+__all__ = ["GenerationConfig", "generate"]

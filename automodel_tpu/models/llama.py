@@ -201,7 +201,8 @@ class LlamaForCausalLM:
     def _decoder_layer(self, hidden, layer_params, position_ids, segment_ids,
                        attention_mask, inv_freq, adapters=None,
                        adapter_scale=1.0, adapter_dropout=0.0,
-                       dropout_position="post", dropout_rng=None):
+                       dropout_position="post", dropout_rng=None,
+                       kv_cache=None, cache_index=None):
         cfg = self.config
         B, S, H = hidden.shape
         D, Hq, Hk = cfg.head_dim, cfg.num_attention_heads, cfg.num_key_value_heads
@@ -246,12 +247,37 @@ class LlamaForCausalLM:
             q = rms_norm(q, p["self_attn"]["q_norm"]["weight"], cfg.rms_norm_eps)
             k = rms_norm(k, p["self_attn"]["k_norm"]["weight"], cfg.rms_norm_eps)
         q, k = apply_rope(q, k, position_ids, inv_freq)
-        attn = attention(
-            q, k, v,
-            causal=True,
-            segment_ids=segment_ids,
-            attention_mask=attention_mask,
-        )
+        new_cache = None
+        if kv_cache is not None:
+            # Autoregressive decode: write this step's k/v into the static
+            # [B, S_max, Hk, D] cache.  Prefill (S > 1) attends only over
+            # its own S keys — attending the full cache would double the
+            # attention FLOPs/memory on positions the causal mask forbids
+            # anyway; decode steps (S == 1) attend the cache.
+            from automodel_tpu.ops.attention import cached_attention
+
+            k_cache = lax.dynamic_update_slice(
+                kv_cache["k"], k.astype(kv_cache["k"].dtype), (0, cache_index, 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                kv_cache["v"], v.astype(kv_cache["v"].dtype), (0, cache_index, 0, 0))
+            new_cache = {"k": k_cache, "v": v_cache}
+            if S > 1:
+                attn = attention(
+                    q, k, v, causal=True,
+                    attention_mask=(None if attention_mask is None
+                                    else attention_mask[:, :S]))
+            else:
+                attn = cached_attention(
+                    q, k_cache, v_cache,
+                    cache_index=cache_index, q_len=S,
+                    attention_mask=attention_mask)
+        else:
+            attn = attention(
+                q, k, v,
+                causal=True,
+                segment_ids=segment_ids,
+                attention_mask=attention_mask,
+            )
         attn = proj(attn.reshape(B, S, Hq * D), p["self_attn"]["o_proj"],
                     "self_attn.o_proj")
         hidden = resid + attn
@@ -264,7 +290,8 @@ class LlamaForCausalLM:
         down = proj(jax.nn.silu(gate) * up, p["mlp"]["down_proj"],
                     "mlp.down_proj")
         # SP/CP activation layout between blocks (no-op without a sharding ctx)
-        return constrain(resid + down, ("act_batch", "act_seq", "act_embed"))
+        out = constrain(resid + down, ("act_batch", "act_seq", "act_embed"))
+        return (out, new_cache) if kv_cache is not None else out
 
     def __call__(
         self,
@@ -279,6 +306,8 @@ class LlamaForCausalLM:
         adapter_dropout: float = 0.0,
         adapter_dropout_position: str = "post",
         dropout_rng: Optional[jax.Array] = None,
+        kv_cache: Optional[Dict[str, jnp.ndarray]] = None,
+        cache_index: Optional[jnp.ndarray] = None,
     ) -> Dict[str, jnp.ndarray]:
         """Forward pass. Returns ``{"logits": ...}`` or, with ``return_hidden``,
         ``{"hidden_states": ..., "lm_head_kernel": ...}`` for fused linear CE
@@ -287,7 +316,11 @@ class LlamaForCausalLM:
         ``adapters``: rank-r LoRA bypass weights, keyed by in-layer module
         path (``"self_attn.q_proj"``) with layer-stacked ``{"A": [L, in, r],
         "B": [L, r, out]}`` values — they ride the layer scan next to the
-        base params (see ``automodel_tpu/peft/lora.py``)."""
+        base params (see ``automodel_tpu/peft/lora.py``).
+
+        ``kv_cache``/``cache_index``: autoregressive decode (see
+        ``automodel_tpu/generation``) — the result carries the updated cache
+        under ``"kv_cache"``."""
         hidden = params["embed_tokens"]["embedding"][input_ids].astype(self.compute_dtype)
         return self.forward_embeds(
             params, hidden, position_ids=position_ids,
@@ -295,7 +328,17 @@ class LlamaForCausalLM:
             return_hidden=return_hidden, adapters=adapters,
             adapter_scale=adapter_scale, adapter_dropout=adapter_dropout,
             adapter_dropout_position=adapter_dropout_position,
-            dropout_rng=dropout_rng)
+            dropout_rng=dropout_rng, kv_cache=kv_cache,
+            cache_index=cache_index)
+
+    def init_kv_cache(self, batch: int, max_len: int,
+                      dtype: Optional[Any] = None) -> Dict[str, jnp.ndarray]:
+        """Static-shape decode cache: ``{"k"|"v": [L, B, max_len, Hk, D]}``."""
+        cfg = self.config
+        dtype = dtype or self.compute_dtype
+        shape = (cfg.num_hidden_layers, batch, max_len,
+                 cfg.num_key_value_heads, cfg.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
     def forward_embeds(
         self,
@@ -310,13 +353,17 @@ class LlamaForCausalLM:
         adapter_dropout: float = 0.0,
         adapter_dropout_position: str = "post",
         dropout_rng: Optional[jax.Array] = None,
+        kv_cache: Optional[Dict[str, jnp.ndarray]] = None,
+        cache_index: Optional[jnp.ndarray] = None,
     ) -> Dict[str, jnp.ndarray]:
         """Forward from input embeddings — the VLM path (image features
         already merged into the token stream)."""
         cfg = self.config
         B, S = hidden.shape[:2]
         if position_ids is None:
-            position_ids = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+            start = 0 if cache_index is None else cache_index
+            position_ids = start + jnp.broadcast_to(
+                jnp.arange(S, dtype=jnp.int32), (B, S))
         hidden = constrain(hidden.astype(self.compute_dtype),
                            ("act_batch", "act_seq", "act_embed"))
         inv_freq = jnp.asarray(self.inv_freq)
@@ -330,24 +377,32 @@ class LlamaForCausalLM:
                 if k.startswith("layers.")}
         layer_idx = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
 
+        decoding = kv_cache is not None
+
         def body(h, xs):
-            layer_params, ad, idx = xs
+            layer_params, ad, idx, cache = xs
             rng = (jax.random.fold_in(dropout_rng, idx)
                    if dropout_rng is not None else None)
-            return self._decoder_layer(
+            out = self._decoder_layer(
                 h, layer_params, position_ids, segment_ids, attention_mask,
                 inv_freq, adapters=ad, adapter_scale=adapter_scale,
                 adapter_dropout=adapter_dropout,
                 dropout_position=adapter_dropout_position, dropout_rng=rng,
-            ), None
+                kv_cache=cache, cache_index=cache_index,
+            )
+            if decoding:
+                h, new_cache = out
+                return h, new_cache
+            return out, None
 
-        if self.remat:
+        if self.remat and not decoding:
             policy = None
             if self.remat_policy and self.remat_policy != "none":
                 policy = getattr(jax.checkpoint_policies, self.remat_policy, None)
             body = jax.checkpoint(body, policy=policy, prevent_cse=False)
-        hidden, _ = lax.scan(
-            body, hidden, (params["layers"], layer_adapters, layer_idx))
+        hidden, new_cache = lax.scan(
+            body, hidden,
+            (params["layers"], layer_adapters, layer_idx, kv_cache))
 
         hidden = rms_norm(hidden, params["norm"]["weight"], cfg.rms_norm_eps)
         lm_kernel = (
@@ -358,7 +413,11 @@ class LlamaForCausalLM:
         if return_hidden:
             return {"hidden_states": hidden, "lm_head_kernel": lm_kernel}
         logits = hidden @ lm_kernel.astype(self.compute_dtype)
-        return {"logits": constrain(logits, ("act_batch", "act_seq_nosp", "act_vocab"))}
+        out = {"logits": constrain(logits,
+                                   ("act_batch", "act_seq_nosp", "act_vocab"))}
+        if decoding:
+            out["kv_cache"] = new_cache
+        return out
 
     @property
     def num_params(self) -> int:

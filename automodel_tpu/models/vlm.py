@@ -107,6 +107,10 @@ class VLMForConditionalGeneration:
         x = jax.nn.gelu(x, approximate=True)
         return x @ p["fc2"]["kernel"].astype(cd) + p["fc2"]["bias"].astype(cd)
 
+    def init_kv_cache(self, batch: int, max_len: int, dtype=None):
+        """Decode cache for the language decoder (generation path)."""
+        return self.language_model.init_kv_cache(batch, max_len, dtype)
+
     def __call__(
         self,
         params: Dict[str, Any],
@@ -116,6 +120,8 @@ class VLMForConditionalGeneration:
         segment_ids: Optional[jnp.ndarray] = None,
         attention_mask: Optional[jnp.ndarray] = None,
         return_hidden: bool = False,
+        kv_cache: Optional[Dict[str, jnp.ndarray]] = None,
+        cache_index: Optional[jnp.ndarray] = None,
     ) -> Dict[str, jnp.ndarray]:
         lm = self.language_model
         lp = params["language_model"]
@@ -139,7 +145,8 @@ class VLMForConditionalGeneration:
         return lm.forward_embeds(
             lp, embeds,
             position_ids=position_ids, segment_ids=segment_ids,
-            attention_mask=attention_mask, return_hidden=return_hidden)
+            attention_mask=attention_mask, return_hidden=return_hidden,
+            kv_cache=kv_cache, cache_index=cache_index)
 
     def flops_per_token(self) -> float:
         return self.language_model.flops_per_token()

@@ -111,6 +111,30 @@ def dot_product_attention(
     return out.reshape(B, Sq, Hq, D)
 
 
+def cached_attention(
+    q: jnp.ndarray,        # [B, T, Hq, D] current-step queries
+    k_cache: jnp.ndarray,  # [B, S_max, Hk, D] static decode cache
+    v_cache: jnp.ndarray,
+    *,
+    cache_index: jnp.ndarray,            # scalar: queries start at this pos
+    q_len: int,
+    attention_mask: Optional[jnp.ndarray] = None,  # [B, S_max] padding mask
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Decode-step attention over a static kv cache.
+
+    The causal mask with ``q_offset=cache_index`` covers both constraints at
+    once: queries see only positions ``<= cache_index + t``, and unwritten
+    cache tail positions are in every query's future, so the zeros there are
+    never attended.  Decode is bandwidth-bound — XLA's SDPA is the right
+    tool, no Pallas needed.
+    """
+    del q_len  # shape-derived; kept for call-site clarity
+    return dot_product_attention(
+        q, k_cache, v_cache, causal=True, q_offset=cache_index,
+        attention_mask=attention_mask, scale=scale)
+
+
 def attention(
     q: jnp.ndarray,  # [B, S, Hq, D]
     k: jnp.ndarray,  # [B, S, Hk, D]

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Load a fine-tuned VLM checkpoint and generate from an image + prompt.
+
+TPU equivalent of the reference's generation example
+(``/root/reference/examples/vlm_generate/generate.py``): supports both a
+consolidated HF safetensors export and an Orbax (non-consolidated) training
+checkpoint, optionally with a LoRA adapter.
+
+Usage:
+    # consolidated HF export (epoch_X_step_Y/model/)
+    python examples/vlm_generate/generate.py \
+        --checkpoint-path ckpts/epoch_0_step_200/model \
+        --prompt "Describe this receipt." --image receipt.png
+
+    # distributed (orbax) checkpoint + base model config
+    python examples/vlm_generate/generate.py \
+        --checkpoint-path ckpts/epoch_0_step_200 \
+        --base-model /path/to/hf/model \
+        --prompt "..." --image img.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+
+def load_model_and_params(args):
+    from automodel_tpu.checkpoint.checkpointing import (
+        CheckpointingConfig,
+        load_model,
+    )
+    from automodel_tpu.models.auto_model import AutoModelForImageTextToText
+
+    path = args.checkpoint_path
+    if os.path.exists(os.path.join(path, "config.json")):
+        # consolidated HF repo: config + weights in one place
+        model = AutoModelForImageTextToText.from_pretrained(path)
+        params = load_model(model, path, CheckpointingConfig(
+            model_save_format="safetensors", save_consolidated=True))
+        return model, params
+    if args.base_model is None:
+        raise SystemExit("--base-model is required for orbax checkpoints")
+    model = AutoModelForImageTextToText.from_pretrained(args.base_model)
+    weights = os.path.join(path, "model")
+    params = load_model(model, weights, CheckpointingConfig(
+        model_save_format="safetensors", save_consolidated=False))
+    return model, params
+
+
+def load_image(path_or_url: str):
+    """Raw PIL image — the processor applies its own rescale/normalize,
+    matching the training collators (which also hand it raw images)."""
+    from PIL import Image
+
+    if path_or_url.startswith(("http://", "https://")):
+        raise SystemExit("zero-egress environment: pass a local image path")
+    return Image.open(path_or_url).convert("RGB")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--checkpoint-path", required=True)
+    p.add_argument("--base-model", default=None,
+                   help="HF model dir (orbax checkpoints only)")
+    p.add_argument("--prompt", required=True)
+    p.add_argument("--image", required=True, help="local image path")
+    p.add_argument("--max-new-tokens", type=int, default=128)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy")
+    args = p.parse_args(argv)
+
+    from transformers import AutoProcessor
+
+    from automodel_tpu.generation import GenerationConfig, generate
+
+    model, params = load_model_and_params(args)
+    proc_dir = (args.checkpoint_path
+                if os.path.exists(os.path.join(args.checkpoint_path,
+                                               "tokenizer_config.json"))
+                else args.base_model)
+    processor = AutoProcessor.from_pretrained(proc_dir)
+
+    conversation = [{"role": "user", "content": [
+        {"type": "image", "image": args.image},
+        {"type": "text", "text": args.prompt}]}]
+    text = processor.apply_chat_template(conversation, tokenize=False,
+                                         add_generation_prompt=True)
+    batch = processor(text=[text], images=[[load_image(args.image)]],
+                      return_tensors="np")
+
+    from automodel_tpu.datasets.vlm.collate_fns import to_nhwc
+
+    cfg = GenerationConfig(
+        max_new_tokens=args.max_new_tokens,
+        do_sample=args.temperature > 0,
+        temperature=max(args.temperature, 1e-6),
+        eos_token_id=getattr(processor.tokenizer, "eos_token_id", None),
+        pad_token_id=getattr(processor.tokenizer, "pad_token_id", 0) or 0)
+    out = generate(model, params,
+                   np.asarray(batch["input_ids"], np.int32),
+                   config=cfg,
+                   pixel_values=to_nhwc(batch["pixel_values"]))
+    print(processor.tokenizer.decode([t for t in out[0] if t not in
+                                      (cfg.pad_token_id,)],
+                                     skip_special_tokens=True))
+
+
+if __name__ == "__main__":
+    main()
