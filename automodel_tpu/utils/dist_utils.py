@@ -1,0 +1,45 @@
+"""Multi-host coordination helpers.
+
+Reference analogue: ``components/utils/dist_utils.py:30-219``.  Most of that
+file (``get_sync_ctx``, ``rescale_gradients``, ``clip_gradients``) collapses
+into the jitted train step under GSPMD — gradient sync, scaling and global-
+norm clipping are all inside one XLA program (``training/train_step.py``).
+What remains host-side is execution ordering: ``FirstRankPerNode``-style
+"leader does the download, everyone else waits".
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def _barrier(tag: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+@contextlib.contextmanager
+def first_rank_first(tag: str = "first_rank_first"):
+    """Process 0 runs the body first; everyone else runs it after.
+
+    The reference's ``FirstRankPerNode`` (``utils/dist_utils.py:30``) exists
+    because torch runs 8 ranks per node and only local-rank-0 should hit the
+    network/disk; JAX runs one process per host, so every process IS its
+    node's leader and the useful ordering is global-leader-first (e.g. one
+    host populates a shared cache, the rest read it).
+
+    COLLECTIVE: every process must enter the context.
+    """
+    is_leader = jax.process_index() == 0
+    if not is_leader:
+        _barrier(f"{tag}:leader_done")
+    try:
+        yield is_leader
+    finally:
+        if is_leader:
+            _barrier(f"{tag}:leader_done")
+        _barrier(f"{tag}:all_done")

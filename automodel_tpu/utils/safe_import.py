@@ -1,0 +1,57 @@
+"""Guarded optional imports with self-describing placeholders.
+
+Role of the reference's ``shared/import_utils.py:36-323`` (``safe_import`` /
+``safe_import_from`` + ``UnavailableMeta``): optional dependencies (wandb,
+PIL, datasets, ...) import to a placeholder that raises a clear error at
+USE time instead of import time, so modules can be imported on minimal
+installs and only the features that need the dependency fail.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Tuple
+
+
+class UnavailablePlaceholder:
+    """Stands in for a missing module/symbol; any use raises ImportError."""
+
+    def __init__(self, name: str, error: Exception):
+        self._name = name
+        self._error = error
+
+    def _raise(self):
+        raise ImportError(
+            f"{self._name} is required for this feature but could not be "
+            f"imported: {self._error}")
+
+    def __getattr__(self, item):
+        self._raise()
+
+    def __call__(self, *args, **kwargs):
+        self._raise()
+
+    def __bool__(self) -> bool:
+        return False
+
+    def __repr__(self) -> str:
+        return f"<unavailable: {self._name}>"
+
+
+def safe_import(module: str) -> Tuple[bool, Any]:
+    """(imported_ok, module_or_placeholder)."""
+    try:
+        return True, importlib.import_module(module)
+    except Exception as e:  # ImportError and transitive init failures alike
+        return False, UnavailablePlaceholder(module, e)
+
+
+def safe_import_from(module: str, symbol: str) -> Tuple[bool, Any]:
+    """(imported_ok, symbol_or_placeholder)."""
+    ok, mod = safe_import(module)
+    if not ok:
+        return False, UnavailablePlaceholder(f"{module}.{symbol}", mod._error)
+    try:
+        return True, getattr(mod, symbol)
+    except AttributeError as e:
+        return False, UnavailablePlaceholder(f"{module}.{symbol}", e)

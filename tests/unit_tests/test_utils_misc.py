@@ -1,0 +1,52 @@
+"""safe_import placeholders, first-rank ordering, compile-cache config."""
+
+import pytest
+
+
+def test_safe_import_success_and_failure():
+    from automodel_tpu.utils.safe_import import safe_import, safe_import_from
+
+    ok, np_mod = safe_import("numpy")
+    assert ok and np_mod.asarray([1]).shape == (1,)
+
+    ok, missing = safe_import("definitely_not_a_module_xyz")
+    assert not ok
+    assert not missing  # falsy placeholder
+    with pytest.raises(ImportError, match="definitely_not_a_module_xyz"):
+        missing.anything
+    with pytest.raises(ImportError):
+        missing()
+
+    ok, fn = safe_import_from("numpy", "asarray")
+    assert ok and fn([2]).shape == (1,)
+    ok, bad = safe_import_from("numpy", "no_such_symbol_abc")
+    assert not ok
+    with pytest.raises(ImportError, match="no_such_symbol_abc"):
+        bad()
+
+
+def test_first_rank_first_single_process():
+    from automodel_tpu.utils.dist_utils import first_rank_first
+
+    with first_rank_first() as is_leader:
+        assert is_leader  # single process is always the leader
+
+
+def test_compile_config_applies_cache_dir(tmp_path, monkeypatch):
+    import jax
+
+    from automodel_tpu.utils.compile_utils import (
+        apply_compile_config,
+        build_compile_config,
+    )
+
+    cfg = build_compile_config(
+        None, enabled=True, cache_dir=str(tmp_path), mode="max-autotune")
+    assert cfg.mode == "max-autotune"  # torch knob accepted, ignored
+    apply_compile_config(cfg)
+    assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+
+    # disabled config must not touch the setting
+    apply_compile_config(build_compile_config(None, enabled=False,
+                                              cache_dir="/nope"))
+    assert jax.config.jax_compilation_cache_dir == str(tmp_path)
