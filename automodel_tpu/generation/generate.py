@@ -107,13 +107,20 @@ def _generate_jit(model, params, left_ids, prompt_lens, cfg: GenerationConfig,
             done = done | (tok == cfg.eos_token_id)
         return (cache, sampled, done), emitted
 
-    steps = cfg.max_new_tokens
+    # N tokens need only N-1 decode forwards: each scan step emits its
+    # carry token and samples the next; the final carry is emitted without
+    # another model call.
+    steps = cfg.max_new_tokens - 1
     done = jnp.zeros((B,), bool)
-    (_, _, _), emitted = lax.scan(
-        step, (cache, next_tok, done),
-        (jnp.arange(steps), jax.random.split(jax.random.fold_in(key, 1),
-                                             steps)))
-    return emitted.T                               # [B, max_new_tokens]
+    if steps > 0:
+        (_, last, done), emitted = lax.scan(
+            step, (cache, next_tok, done),
+            (jnp.arange(steps), jax.random.split(jax.random.fold_in(key, 1),
+                                                 steps)))
+    else:
+        last, emitted = next_tok, jnp.zeros((0, B), jnp.int32)
+    final = jnp.where(done, cfg.pad_token_id, last)[None]
+    return jnp.concatenate([emitted, final], axis=0).T  # [B, max_new_tokens]
 
 
 def generate(model, params, input_ids, prompt_lens=None,

@@ -100,6 +100,7 @@ class LlamaForCausalLM:
         compute_dtype: jnp.dtype = jnp.bfloat16,
         remat: bool = True,
         remat_policy: Optional[str] = "nothing_saveable",
+        weight_only_quant: Optional[str] = None,   # "int8": QLoRA-style base
     ):
         self.config = config
         self.param_dtype = jnp.dtype(param_dtype)
@@ -107,6 +108,11 @@ class LlamaForCausalLM:
         self.remat = remat
         self.remat_policy = remat_policy
         self.quant = None  # set by quantization.fp8.apply_fp8_to_model
+        # Weight-only quantized layer kernels (int8 + per-out-channel scale,
+        # dequantized on the fly in proj) — the bitsandbytes-QLoRA role
+        # (reference ``_peft/lora.py:32,308-314``), TPU-shaped: frozen base
+        # weights cost 1 byte/param in HBM, adapters stay bf16/fp32.
+        self.weight_only_quant = weight_only_quant
         self.inv_freq = rope_frequencies(
             config.head_dim, config.rope_theta, config.rope_scaling
         )
@@ -156,6 +162,12 @@ class LlamaForCausalLM:
         }
         if not cfg.tie_word_embeddings:
             params["lm_head"] = {"kernel": dense(next(keys), (H, cfg.vocab_size), layers=False)}
+        if self.weight_only_quant == "int8":
+            from automodel_tpu.quantization.weight_only import (
+                quantize_base_params,
+            )
+
+            params = quantize_base_params(params)
         return params
 
     def abstract_params(self) -> Dict[str, Any]:
@@ -195,6 +207,17 @@ class LlamaForCausalLM:
         }
         if not cfg.tie_word_embeddings:
             axes["lm_head"] = {"kernel": ("embed", "vocab")}
+        if self.weight_only_quant == "int8":
+            # per-out-channel scales: [L, 1, out] shards like the kernel's
+            # output axis, contraction axis replicated
+            from automodel_tpu.quantization.weight_only import (
+                QUANTIZED_MODULES,
+            )
+
+            for mod, proj in QUANTIZED_MODULES:
+                kaxes = axes["layers"][mod][proj]["kernel"]
+                axes["layers"][mod][proj]["scale"] = (
+                    kaxes[0], None, kaxes[2])
         return axes
 
     # -- forward -----------------------------------------------------------
@@ -210,7 +233,14 @@ class LlamaForCausalLM:
         cd = self.compute_dtype
 
         def proj(x, w, name):
-            y = maybe_qdot(x, w["kernel"].astype(cd), self.quant, name)
+            kern = w["kernel"]
+            if kern.dtype == jnp.int8:
+                # weight-only dequant: XLA fuses the scale-multiply into the
+                # matmul's operand read
+                kern = kern.astype(cd) * w["scale"].astype(cd)
+            else:
+                kern = kern.astype(cd)
+            y = maybe_qdot(x, kern, self.quant, name)
             if adapters is not None and name in adapters:
                 # Rank-r LoRA bypass: y += s * (x@A)@B — never materializes
                 # the merged [in, out] kernel (reference Triton path intent,

@@ -14,9 +14,11 @@ per model (``PeftConfig.use_rank_r_bypass`` overrides):
   no merged kernel is ever materialized, grads stay rank-r, and LoRA
   dropout is supported; this is the path for 8B+ models and dropout runs.
 
-Base params are frozen through the optimizer mask (``optax.set_to_zero``,
-see ``automodel_tpu/optim/builder.py``), matching the reference's
-``requires_grad=False`` freeze at ``_peft/lora.py:322-363``.
+Base params are frozen by the train step's trainable-subtree mode
+(``build_train_step(trainable_mask=...)``, ``training/train_step.py``):
+gradients, accumulation buffers and optimizer state exist only for the
+adapters — the reference's ``requires_grad=False`` freeze
+(``_peft/lora.py:322-363``) without a full-tree grad buffer.
 """
 
 from __future__ import annotations
@@ -59,6 +61,10 @@ class PeftConfig:
     # (>4B params); the merged path is measurably faster for small models
     # (13.2k vs 11.7k tok/s on the 1B/rank-8 single-chip bench).
     use_rank_r_bypass: Optional[bool] = None
+    # "int8": freeze the base as weight-only-quantized kernels (QLoRA role;
+    # reference bitsandbytes interop, ``_peft/lora.py:32,308-314``).
+    # Requires the rank-r bypass (int8 kernels cannot be merged with fp A@B).
+    quantize_base: Optional[str] = None
 
     def __post_init__(self):
         if self.dropout_position not in ("pre", "post"):
@@ -104,12 +110,8 @@ class LoRAModel:
     merging LoRA deltas into the targeted kernels."""
 
     def __init__(self, base_model, peft_config: PeftConfig):
-        self.base_model = base_model
-        self.peft_config = peft_config
-        self.targets = match_targets(base_model, peft_config)
-        if not self.targets:
-            raise ValueError(
-                f"PEFT matched no modules for targets {peft_config.target_modules}")
+        # Validate EVERYTHING before mutating base_model: a failed
+        # construction must not leave the caller's model flipped to int8.
         # Rank-r bypass (y += s*(x@A)@B, grads stay rank-r — no merged
         # [in, out] kernel is ever materialized) needs forward support; the
         # merge path is the fallback for models without it (GPT-2, VLM).
@@ -129,11 +131,33 @@ class LoRAModel:
         else:
             self._bypass = supports and (
                 peft_config.dropout > 0.0
+                or peft_config.quantize_base is not None
                 or getattr(base_model, "num_params", 0) > 4e9)
         if not self._bypass and peft_config.dropout:
             raise ValueError(
                 "LoRA dropout needs the rank-r bypass forward; "
                 f"{type(base_model).__name__} only supports the merged path")
+        if peft_config.quantize_base:
+            if peft_config.quantize_base != "int8":
+                raise ValueError(
+                    f"quantize_base={peft_config.quantize_base!r}: only "
+                    "'int8' weight-only quantization is supported")
+            if not hasattr(base_model, "weight_only_quant"):
+                raise ValueError(
+                    f"{type(base_model).__name__} does not support "
+                    "weight-only base quantization")
+            if not self._bypass:
+                raise ValueError(
+                    "quantize_base needs the rank-r bypass forward (an int8 "
+                    "kernel cannot be merged with the fp adapter delta)")
+        self.base_model = base_model
+        self.peft_config = peft_config
+        self.targets = match_targets(base_model, peft_config)
+        if not self.targets:
+            raise ValueError(
+                f"PEFT matched no modules for targets {peft_config.target_modules}")
+        if peft_config.quantize_base:
+            base_model.weight_only_quant = peft_config.quantize_base
 
     @property
     def wants_dropout_rng(self) -> bool:

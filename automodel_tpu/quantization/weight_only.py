@@ -1,0 +1,100 @@
+"""Weight-only int8 quantization for frozen PEFT bases (QLoRA equivalent).
+
+Reference analogue: bitsandbytes 4/8-bit quantized Linear under LoRA
+(``nemo_automodel/components/_peft/lora.py:32,308-314``).  TPU shape:
+kernels live in HBM as ``int8`` with a per-output-channel fp32 scale and are
+dequantized on the fly inside the layer (``models/llama.py`` proj) — XLA
+fuses the scale multiply into the matmul read, the frozen base costs
+1 byte/param, and adapters/optimizer state stay in full precision.  Only
+makes sense with the trainable-subtree train step (int8 leaves are not
+differentiable, and never need to be).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+INT8_MAX = 127.0
+
+# In-layer module dicts whose "kernel" gets quantized (embeddings and
+# lm_head stay in full precision — they feed gathers/logits, not projs).
+QUANTIZED_MODULES = (
+    ("self_attn", "q_proj"), ("self_attn", "k_proj"),
+    ("self_attn", "v_proj"), ("self_attn", "o_proj"),
+    ("mlp", "gate_proj"), ("mlp", "up_proj"), ("mlp", "down_proj"),
+)
+
+
+def quantize_kernel(w: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[..., in, out] -> (int8 [..., in, out], fp32 scale [..., 1, out]).
+
+    Per-output-channel symmetric scaling: each output column's amax maps to
+    127, which keeps the matmul's contraction error independent across
+    output features.
+    """
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / INT8_MAX
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                 -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_base_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize a Llama-family param tree's layer kernels in place-shape:
+    each targeted ``{"kernel": w}`` becomes ``{"kernel": int8, "scale": s}``
+    (plus any existing bias)."""
+    out = jax.tree.map(lambda x: x, params)  # shallow-copy containers
+    layers = out["layers"]
+    for mod, proj in QUANTIZED_MODULES:
+        node = dict(layers[mod][proj])
+        q, s = quantize_kernel(node["kernel"])
+        node["kernel"], node["scale"] = q, s
+        layers[mod][proj] = node
+    return out
+
+
+def dequantize_base_params(params: Dict[str, Any],
+                           dtype=jnp.bfloat16) -> Dict[str, Any]:
+    """Inverse transform (checkpoint export back to dense weights)."""
+    out = jax.tree.map(lambda x: x, params)
+    layers = out["layers"]
+    for mod, proj in QUANTIZED_MODULES:
+        node = dict(layers[mod][proj])
+        w = (node.pop("kernel").astype(jnp.float32)
+             * node.pop("scale").astype(jnp.float32))
+        node["kernel"] = w.astype(dtype)
+        layers[mod][proj] = node
+    return out
+
+
+def load_quantized_hf_base(model, ckpt_dir: str, shardings=None):
+    """Stream HF bf16 weights, then quantize into the model's int8 layout.
+
+    ``model`` has ``weight_only_quant`` set; a flag-off twin supplies the
+    dense abstract tree for streaming, and the quantize transform runs
+    jitted with the final (quantized) shardings as outputs.
+    """
+    from automodel_tpu.models.hf_io import load_hf_weights
+    from automodel_tpu.models.llama import LlamaForCausalLM
+
+    twin = LlamaForCausalLM(
+        model.config, param_dtype=model.param_dtype,
+        compute_dtype=model.compute_dtype, remat=model.remat)
+
+    dense_shardings = None
+    if shardings is not None:
+        dense_shardings = jax.tree.map(lambda x: x, shardings)
+        layers = dense_shardings["layers"]
+        for mod, proj in QUANTIZED_MODULES:
+            node = dict(layers[mod][proj])
+            node.pop("scale", None)
+            layers[mod][proj] = node
+
+    dense = load_hf_weights(twin, ckpt_dir, shardings=dense_shardings)
+    quantize = jax.jit(quantize_base_params, donate_argnums=0,
+                       **({"out_shardings": shardings}
+                          if shardings is not None else {}))
+    return quantize(dense)

@@ -159,11 +159,15 @@ def build_wandb(cfg: ConfigNode):
     wandb_cfg = cfg.get("wandb")
     if wandb_cfg is None or jax.process_index() != 0:
         return None
-    try:
-        import wandb
+    from automodel_tpu.utils.safe_import import safe_import
 
+    ok, wandb = safe_import("wandb")
+    if not ok:
+        logger.warning("wandb disabled: %s", wandb)
+        return None
+    try:
         return wandb.init(**{k: v for k, v in wandb_cfg.to_dict().items()})
-    except Exception as e:  # offline / not installed
+    except Exception as e:  # offline / misconfigured
         logger.warning("wandb disabled: %s", e)
         return None
 
@@ -188,6 +192,15 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         self.dist_info = initialize_distributed(
             **(cfg.get("dist_env").to_dict()
                if cfg.get("dist_env") is not None else {}))
+
+        # Persistent XLA compile cache (the torch.compile-config analogue)
+        if cfg.get("compile") is not None:
+            from automodel_tpu.utils.compile_utils import (
+                apply_compile_config,
+                build_compile_config,
+            )
+
+            apply_compile_config(build_compile_config(cfg.get("compile")))
 
         # RNG
         rng_cfg = cfg.get("rng")
@@ -251,9 +264,17 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         opt_kwargs = {k: v for k, v in (opt_cfg.to_dict() if opt_cfg else {}).items()
                       if k != "_target_"}
         target = opt_cfg.get("_target_") if opt_cfg is not None else None
+        step_mask = None
         if isinstance(target, str) and not target.startswith("torch.optim"):
             from automodel_tpu.config.loader import resolve_target
 
+            if getattr(getattr(self.model, "base_model", None),
+                       "weight_only_quant", None):
+                raise ValueError(
+                    "peft.quantize_base requires the built-in optimizer "
+                    "path (trainable-subtree gradients); a custom "
+                    "optimizer._target_ would differentiate the int8 base")
+            # custom optimizer factories own their masking (old contract)
             self.optimizer = resolve_target(target)(mask=mask, **opt_kwargs)
         else:
             # Top-level ``max_grad_norm`` (reference passes it per-call,
@@ -265,7 +286,12 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 opt_kwargs.setdefault("grad_clip_norm", float(max_gn))
             if isinstance(target, str):
                 opt_kwargs.setdefault("name", target.rsplit(".", 1)[-1].lower())
-            self.optimizer = build_optimizer(mask=mask, **opt_kwargs)
+            # Freezing via the train step's trainable-subtree mode: grads,
+            # accumulation buffers and optimizer state exist only for the
+            # trainable leaves (vs optax.masked, which still pays a
+            # full-tree grad buffer per step).
+            self.optimizer = build_optimizer(**opt_kwargs)
+            step_mask = mask
 
         # Jitted step; ``training.grad_dtype: bfloat16`` switches the
         # grad-accumulation buffers off fp32 (the fast SFT default in the
@@ -278,7 +304,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             step_kwargs["grad_dtype"] = jnp.dtype(str(tr_cfg.get("grad_dtype")))
         self.step_fns = build_train_step(
             self.model, self.optimizer, loss_fn=self.loss_fn, plan=self.plan,
-            **step_kwargs)
+            trainable_mask=step_mask, **step_kwargs)
 
         # Params: stream HF weights into shards, or fresh init
         ckpt_dir = getattr(self.model, "checkpoint_dir", None)
@@ -286,9 +312,18 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             from automodel_tpu.models.hf_io import load_hf_weights
 
             if self.peft_config is not None:
-                base = load_hf_weights(
-                    self.model.base_model, ckpt_dir,
-                    shardings=self.param_sharding["base"])
+                if getattr(self.model.base_model, "weight_only_quant", None):
+                    from automodel_tpu.quantization.weight_only import (
+                        load_quantized_hf_base,
+                    )
+
+                    base = load_quantized_hf_base(
+                        self.model.base_model, ckpt_dir,
+                        shardings=self.param_sharding["base"])
+                else:
+                    base = load_hf_weights(
+                        self.model.base_model, ckpt_dir,
+                        shardings=self.param_sharding["base"])
                 from automodel_tpu.peft.lora import init_lora_params
 
                 self.params = init_lora_params(
@@ -348,7 +383,14 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
     def _setup_data(self, global_mb: int) -> None:
         cfg = self.cfg
         self.tokenizer = build_tokenizer(cfg, self.model)
-        dataset = build_dataset(cfg.get("dataset"), tokenizer=self.tokenizer)
+        # Leader-first dataset build: host 0 populates the shared HF
+        # datasets cache (download/tokenize/map) before the others read it
+        # (the reference's FirstRankPerNode role, ``utils/dist_utils.py:30``).
+        from automodel_tpu.utils.dist_utils import first_rank_first
+
+        with first_rank_first("dataset_build"):
+            dataset = build_dataset(cfg.get("dataset"),
+                                    tokenizer=self.tokenizer)
         # Per-host input sharding: on a multi-host mesh each host tokenizes
         # and collates only its own dp rows of every global microbatch
         # (reference: per-rank sampler, ``train_ft.py:283-307``); the shared

@@ -122,6 +122,7 @@ def build_train_step(
     loss_fn: Optional[Any] = None,
     plan: Optional[ParallelPlan] = None,
     grad_dtype: Any = jnp.float32,
+    trainable_mask: Optional[Any] = None,
 ) -> TrainStepFns:
     """Build jitted ``train_step(params, opt_state, batch) ->
     (params, opt_state, metrics)`` and ``eval_step(params, batch) -> metrics``.
@@ -129,6 +130,13 @@ def build_train_step(
     ``batch`` arrays are shaped ``[A, B, S]`` with ``A`` = grad-accumulation
     steps (``A=1`` for no accumulation); the scan over ``A`` replaces the
     reference's microbatch loop + sync ctx (``train_ft.py:653-684``).
+
+    ``trainable_mask`` (PEFT / freezing): a boolean pytree over params.
+    Gradients, accumulation buffers and optimizer state then exist ONLY for
+    the trainable subtree — at 1B+ scale this saves a full-model grad buffer
+    per step vs masking the optimizer, and it is what allows a
+    non-differentiable (e.g. int8 weight-only quantized) frozen base.
+    ``tx`` must be UNMASKED in this mode; frozen leaves are closed over.
     """
     loss_fn = loss_fn if loss_fn is not None else MaskedCrossEntropy()
     # Loss contract (typed, not by accident): a loss object must carry
@@ -155,21 +163,36 @@ def build_train_step(
     def count_label_tokens(labels):
         return jnp.sum(labels != IGNORE_INDEX).astype(jnp.float32)
 
+    from automodel_tpu.utils.pytree import combine, partition
+
+    def split_params(params):
+        """(trainable, frozen): identity split when no mask is given."""
+        if trainable_mask is None:
+            return params, None
+        return partition(params, trainable_mask)
+
+    def join_params(trainable, frozen):
+        return trainable if frozen is None else combine(trainable, frozen)
+
     def train_step(params, opt_state, batch):
         num_label_tokens = count_label_tokens(batch["labels"])
         denom = jnp.maximum(num_label_tokens, 1.0)
+        trainable, frozen = split_params(params)
 
-        grad_fn = jax.value_and_grad(
-            functools.partial(_microbatch_loss, model, loss_fn))
+        def loss_of(tr, mb):
+            return _microbatch_loss(model, loss_fn, join_params(tr, frozen),
+                                    mb)
+
+        grad_fn = jax.value_and_grad(loss_of)
 
         def micro(grads_acc, mb):
-            loss_sum, grads = grad_fn(params, mb)
+            loss_sum, grads = grad_fn(trainable, mb)
             grads_acc = jax.tree.map(
                 lambda a, g: a + g.astype(grad_dtype), grads_acc, grads)
             return grads_acc, loss_sum
 
         zero_grads = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, grad_dtype), params)
+            lambda p: jnp.zeros(p.shape, grad_dtype), trainable)
         with ctx():
             grads, loss_sums = jax.lax.scan(micro, zero_grads, batch)
         # Per-token normalization across the *global* step (dp_cp psum
@@ -177,8 +200,9 @@ def build_train_step(
         grads = jax.tree.map(lambda g: g / denom, grads)
         grad_norm = optax.global_norm(grads)
 
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        updates, opt_state = tx.update(grads, opt_state, trainable)
+        trainable = optax.apply_updates(trainable, updates)
+        params = join_params(trainable, frozen)
         metrics = {
             "loss": jnp.sum(loss_sums) / denom,
             "grad_norm": grad_norm,
@@ -199,11 +223,16 @@ def build_train_step(
             "num_label_tokens": num_label_tokens,
         }
 
+    def init_opt(params):
+        return tx.init(split_params(params)[0])
+
     if plan is not None:
         mesh = plan.mesh
         abs_params = model.abstract_params()
-        abs_opt = jax.eval_shape(tx.init, abs_params)
-        opt_specs = state_partition_specs(abs_opt, abs_params, plan.param_specs)
+        abs_train, _ = split_params(abs_params)
+        train_specs, _ = split_params(plan.param_specs)
+        abs_opt = jax.eval_shape(tx.init, abs_train)
+        opt_specs = state_partition_specs(abs_opt, abs_train, train_specs)
         opt_sharding = to_named_shardings(mesh, opt_specs)
         # [A, B, S]: grad-acc axis unsharded, batch over dp, seq over cp.
         mb_sharding = NamedSharding(
@@ -224,14 +253,14 @@ def build_train_step(
             in_shardings=(plan.param_sharding, None),
             out_shardings=rep,
         )
-        init_opt = jax.jit(tx.init, out_shardings=opt_sharding)
-        return TrainStepFns(train_jit, eval_jit, init_opt,
+        init_opt_jit = jax.jit(init_opt, out_shardings=opt_sharding)
+        return TrainStepFns(train_jit, eval_jit, init_opt_jit,
                             opt_sharding, mb_sharding)
 
     return TrainStepFns(
         jax.jit(train_step, donate_argnums=(0, 1)),
         jax.jit(eval_step),
-        jax.jit(tx.init),
+        jax.jit(init_opt),
         None, None,
     )
 

@@ -2,8 +2,10 @@
 
 Reference parity: ``nemo_automodel/components/utils/model_utils.py:50-133``
 (``print_trainable_parameters``, ``apply_parameter_freezing`` by attr name +
-regex patterns).  In the functional world "freezing" is an optax mask
-(True = trainable), consumed by ``build_optimizer(mask=...)``.
+regex patterns).  In the functional world "freezing" is a boolean mask
+(True = trainable), consumed by ``build_train_step(trainable_mask=...)``
+(grads/optimizer state only exist for trainable leaves) or, for custom
+optimizer factories, ``build_optimizer(mask=...)``.
 """
 
 from __future__ import annotations

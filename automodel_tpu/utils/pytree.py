@@ -23,3 +23,21 @@ def unflatten_path_dict(flat: Dict[Tuple[str, ...], Any]) -> Dict[str, Any]:
             node = node.setdefault(part, {})
         node[path[-1]] = v
     return out
+
+
+def partition(tree: Any, mask: Any) -> Tuple[Any, Any]:
+    """Split ``tree`` by a boolean ``mask`` pytree into (selected, rest);
+    unselected positions hold None (combine() reassembles)."""
+    import jax
+
+    sel = jax.tree.map(lambda m, x: x if m else None, mask, tree)
+    rest = jax.tree.map(lambda m, x: None if m else x, mask, tree)
+    return sel, rest
+
+
+def combine(sel: Any, rest: Any) -> Any:
+    """Inverse of :func:`partition`."""
+    import jax
+
+    return jax.tree.map(lambda a, b: b if a is None else a, sel, rest,
+                        is_leaf=lambda x: x is None)

@@ -104,9 +104,12 @@ def main(argv=None):
                    np.asarray(batch["input_ids"], np.int32),
                    config=cfg,
                    pixel_values=to_nhwc(batch["pixel_values"]))
-    print(processor.tokenizer.decode([t for t in out[0] if t not in
-                                      (cfg.pad_token_id,)],
-                                     skip_special_tokens=True))
+    # truncate at eos instead of filtering by value (a pad id of 0 can be a
+    # legitimate vocab token mid-sequence; pads only appear after eos)
+    row = list(out[0])
+    if cfg.eos_token_id is not None and cfg.eos_token_id in row:
+        row = row[: row.index(cfg.eos_token_id)]
+    print(processor.tokenizer.decode(row, skip_special_tokens=True))
 
 
 if __name__ == "__main__":
