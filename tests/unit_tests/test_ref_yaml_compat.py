@@ -80,3 +80,21 @@ def test_fn_key_strings_translate_on_load(tmp_path):
         "  collate_fn: nemo_automodel.components.datasets.utils.default_collater\n")
     cfg = load_yaml_config(str(p))
     assert cfg.get("dataloader.collate_fn") is default_collater
+
+
+def test_repo_example_yamls_parse_and_resolve():
+    """Every example YAML in THIS repo loads and its targets resolve."""
+    repo_examples = os.path.join(os.path.dirname(__file__), "..", "..",
+                                 "examples")
+    targets = set()
+    paths = glob.glob(os.path.join(repo_examples, "**", "*.yaml"),
+                      recursive=True)
+    assert len(paths) >= 8
+    for path in paths:
+        with open(path) as f:
+            data = yaml.safe_load(f)
+        assert isinstance(data, dict), path
+        _collect_targets(data, targets)
+    for t in sorted(targets):
+        obj = resolve_target(t)
+        assert callable(obj) or isinstance(obj, type), t
