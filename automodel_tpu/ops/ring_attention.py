@@ -29,21 +29,108 @@ from jax import lax
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def _block_attend(q, k, v, mask) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One q-block x kv-block attention: returns (unnormalized out, row max,
-    row sumexp) in fp32. q:[B,Sq,Hk,G,D] k/v:[B,Skv,Hk,D] mask:[B,1,Sq,Skv]."""
-    logits = jnp.einsum("bqhgd,bkhd->bhgqk", q, k).astype(jnp.float32)
-    if mask is not None:
-        logits = jnp.where(mask[:, :, None], logits, _NEG_INF)
-    m = jnp.max(logits, axis=-1)                        # [B,Hk,G,Sq]
-    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
-    m_safe = jnp.maximum(m, -1e30)
-    p = jnp.exp(logits - m_safe[..., None])
-    if mask is not None:
-        p = jnp.where(mask[:, :, None], p, 0.0)
-    s = jnp.sum(p, axis=-1)                             # [B,Hk,G,Sq]
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v.dtype), v)
-    return out.astype(jnp.float32), m_safe, s
+# Tile edges for the blockwise inner attention.  Peak transient memory per
+# tile is B*Hk*G*_CQ*_CKV fp32 logits (64 MiB at 32 heads) independent of
+# the shard's sequence length — naive [S, S] logits would be 8.6 GiB at
+# S_local=8k, an OOM before long context even starts.
+_CQ, _CKV = 512, 1024
+
+
+def _ceil_pad(x, mult, axis, value=0.0):
+    pad = (-x.shape[axis]) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def _block_attend(q, k, v, *, q_offset, causal, seg_q, seg_kv
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One q-block x kv-block attention, double-chunked with online softmax
+    (flash-style in XLA): returns (unnormalized out [B,Sq,Hk,G,D], row max
+    [B,Hk,G,Sq], row sumexp [B,Hk,G,Sq]) in fp32.
+
+    Tile masks are computed from position/segment arithmetic on the fly —
+    no [Sq, Skv] mask or logits tensor ever materializes.
+    """
+    B, Sq, Hk, G, D = q.shape
+    Skv = k.shape[1]
+    cq, ckv = min(_CQ, Sq), min(_CKV, Skv)
+
+    qp = _ceil_pad(q, cq, 1)
+    kp = _ceil_pad(k, ckv, 1)
+    vp = _ceil_pad(v, ckv, 1)
+    # Distinct negative sentinels for tile padding: q pads get -1, kv pads
+    # get -2 — they can never equal each other or any real segment id, and
+    # the non-segment path masks kv pads via ``skvc >= 0`` (real data pads
+    # use segment 0 per the framework convention).
+    seg_q_arr = (jnp.zeros((B, Sq), jnp.int32) if seg_q is None else seg_q)
+    seg_kv_arr = (jnp.zeros((B, Skv), jnp.int32) if seg_kv is None else seg_kv)
+    seg_qp = _ceil_pad(seg_q_arr, cq, 1, value=-1)
+    seg_kvp = _ceil_pad(seg_kv_arr, ckv, 1, value=-2)
+    use_segs = seg_q is not None
+
+    nq, nkv = qp.shape[1] // cq, kp.shape[1] // ckv
+    qt = qp.reshape(B, nq, cq, Hk, G, D).transpose(1, 0, 2, 3, 4, 5)
+    kt = kp.reshape(B, nkv, ckv, Hk, D).transpose(1, 0, 2, 3, 4)
+    vt = vp.reshape(B, nkv, ckv, Hk, D).transpose(1, 0, 2, 3, 4)
+    sq_t = seg_qp.reshape(B, nq, cq).transpose(1, 0, 2)
+    skv_t = seg_kvp.reshape(B, nkv, ckv).transpose(1, 0, 2)
+
+    kv_pos0 = jnp.arange(nkv) * ckv
+
+    def q_tile(carry, xs):
+        del carry
+        qc, sqc, qi = xs                         # [B,cq,Hk,G,D], [B,cq], idx
+        q_pos = qi * cq + jnp.arange(cq) + q_offset      # [cq] global
+
+        @functools.partial(jax.checkpoint, prevent_cse=False)
+        def kv_tile(state, xs2):
+            # remat: the backward recomputes this tile's logits/probs instead
+            # of saving [nq*nkv, cq, ckv] fp32 tensors (which would cost as
+            # much as the un-chunked logits)
+            acc, m_run, s_run = state            # [B,cq,Hk,G,D],[B,Hk,G,cq]x2
+            kc, vc, skvc, k0 = xs2
+            logits = jnp.einsum("bqhgd,bkhd->bhgqk", qc, kc
+                                ).astype(jnp.float32)    # [B,Hk,G,cq,ckv]
+            kv_pos = k0 + jnp.arange(ckv)
+            valid = jnp.ones((B, cq, ckv), bool)
+            if causal:
+                valid &= (q_pos[:, None] >= kv_pos[None, :])[None]
+            if use_segs:
+                valid &= sqc[:, :, None] == skvc[:, None, :]
+                valid &= (skvc != 0)[:, None, :]
+            else:
+                valid &= (skvc >= 0)[:, None, :]         # pad tiles only
+            logits = jnp.where(valid[:, None, None], logits, _NEG_INF)
+            m_b = jnp.maximum(jnp.max(logits, -1), -1e30)
+            p = jnp.exp(logits - m_b[..., None])
+            p = jnp.where(valid[:, None, None], p, 0.0)
+            s_b = jnp.sum(p, -1)
+            o_b = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(vc.dtype), vc
+                             ).astype(jnp.float32)
+            m_new = jnp.maximum(m_run, m_b)
+            alpha = jnp.exp(m_run - m_new)
+            beta = jnp.exp(m_b - m_new)
+            acc = acc * alpha[..., None].transpose(0, 3, 1, 2, 4) \
+                + o_b * beta[..., None].transpose(0, 3, 1, 2, 4)
+            return (acc, m_new, s_run * alpha + s_b * beta), None
+
+        st0 = (jnp.zeros((B, cq, Hk, G, D), jnp.float32),
+               jnp.full((B, Hk, G, cq), _NEG_INF, jnp.float32),
+               jnp.zeros((B, Hk, G, cq), jnp.float32))
+        (acc, m_run, s_run), _ = lax.scan(
+            kv_tile, st0, (kt, vt, skv_t, kv_pos0))
+        return None, (acc, m_run, s_run)
+
+    _, (accs, ms, ss) = lax.scan(
+        q_tile, None, (qt, sq_t, jnp.arange(nq)))
+    # [nq,B,cq,...] -> [B,Sq,...]
+    out = accs.transpose(1, 0, 2, 3, 4, 5).reshape(B, nq * cq, Hk, G, D)
+    m = ms.transpose(1, 2, 3, 0, 4).reshape(B, Hk, G, nq * cq)
+    s = ss.transpose(1, 2, 3, 0, 4).reshape(B, Hk, G, nq * cq)
+    return out[:, :Sq], m[..., :Sq], s[..., :Sq]
 
 
 def ring_attention(
@@ -67,20 +154,14 @@ def ring_attention(
 
     qg = (q * scale).reshape(B, S, Hk, G, D)
 
-    def step_mask(kv_idx, seg_kv):
-        from automodel_tpu.ops.attention import make_attention_mask
-
-        # reuse the canonical mask builder: global positions expressed as a
-        # query offset relative to the arriving kv block
-        return make_attention_mask(
-            S, S, causal=causal,
-            segment_ids_q=segment_ids, segment_ids_kv=seg_kv,
-            q_offset=(my_idx - kv_idx) * S)
-
     def attend_and_combine(state, k_t, v_t, seg_t, t):
         acc, m_run, s_run = state
         kv_idx = (my_idx - t) % cp
-        out_b, m_b, s_b = _block_attend(qg, k_t, v_t, step_mask(kv_idx, seg_t))
+        # global positions expressed as a query offset relative to the
+        # arriving kv block (blocks entirely in the future mask to zero)
+        out_b, m_b, s_b = _block_attend(
+            qg, k_t, v_t, q_offset=(my_idx - kv_idx) * S, causal=causal,
+            seg_q=segment_ids, seg_kv=seg_t)
         m_new = jnp.maximum(m_run, m_b)
         alpha = jnp.exp(m_run - m_new)                  # rescale old acc
         beta = jnp.exp(m_b - m_new)
