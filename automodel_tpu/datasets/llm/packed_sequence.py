@@ -51,6 +51,9 @@ class PackedSequence:
         size = self.packed_sequence_size
         cur = _empty_pack()
         contains_loss_mask = "loss_mask" in _first(self.dataset)
+        if (not self.split_across_pack and not contains_loss_mask
+                and self.max_packs is None and self._pack_native(size)):
+            return self
         if contains_loss_mask:
             cur["loss_mask"] = []
         next_seg = 1
@@ -85,6 +88,44 @@ class PackedSequence:
         ]
         logger.info("Total number of packs created: %d", len(self.packs))
         return self
+
+    def _pack_native(self, size: int) -> bool:
+        """C++ fast path (``automodel_tpu/native``) for the common
+        no-split / no-loss-mask case; returns False to use the Python
+        reference implementation."""
+        from automodel_tpu import native
+
+        if not native.available():
+            return False
+        samples = list(self.dataset)
+        lengths = [len(s["input_ids"]) for s in samples]
+        if any(n > size for n in lengths):
+            raise ValueError(
+                f"Dataset sample is too long (> {size}). Set "
+                "split_across_pack=True or increase packed_sequence_size.")
+        ids = np.concatenate(
+            [np.asarray(s["input_ids"], np.int32) for s in samples])
+        labels = np.concatenate(
+            [np.asarray(s["labels"], np.int32) for s in samples])
+        from automodel_tpu.native.build import pack_greedy
+
+        out = pack_greedy(lengths, ids, labels, size, self.padding_idx,
+                          CROSS_ENTROPY_IGNORE_IDX)
+        # per-pack sample lengths from the C++-reported counts (the
+        # grouping logic lives in one place: packing.cpp)
+        nonzero = [n for n in lengths if n > 0]
+        edges = np.cumsum(out["counts"])[:-1]
+        seq_lens = np.split(np.asarray(nonzero, np.int32), edges)
+        self.packed_dataset = [
+            {"input_ids": out["input_ids"][i], "labels": out["labels"][i],
+             "position_ids": out["position_ids"][i],
+             "segment_ids": out["segment_ids"][i],
+             "seq_lens": seq_lens[i]}
+            for i in range(out["input_ids"].shape[0])
+        ]
+        logger.info("Total number of packs created: %d (native)",
+                    len(self.packed_dataset))
+        return True
 
     def _stop(self) -> bool:
         return self.max_packs is not None and len(self.packs) >= self.max_packs

@@ -85,18 +85,31 @@ def default_collater(batch: List[dict],
                      pad_seq_len_divisible: Optional[int] = None) -> Dict[str, np.ndarray]:
     """Pad-and-stack collater.  Returns int32 numpy arrays (int32 is the TPU-
     native integer width; torch's LongTensor (int64) would double HBM traffic
-    for ids)."""
+    for ids).  The pad loop runs in the native C++ core when available
+    (``automodel_tpu/native``)."""
+    from automodel_tpu.native.build import collate_pad
+
     pad_token_ids = batch[0].pop(PAD_SENTINEL_KEY, None)
     for item in batch[1:]:
         item.pop(PAD_SENTINEL_KEY, None)
     out = {}
     for key in batch[0].keys():
-        padded = pad_within_micro(
-            extract_key_from_dicts(batch, key),
-            get_pad_token_from_key(key, pad_token_ids),
-            pad_seq_len_divisible,
-        )
-        out[key] = batchify(np.asarray(padded, dtype=np.int32))
+        rows = extract_key_from_dicts(batch, key)
+        # padding convention defined ONCE for both branches (the native
+        # path mirrors pad_within_micro exactly, including its rounding)
+        pad_id = get_pad_token_from_key(key, pad_token_ids)
+        if pad_id is None:
+            pad_id = rows[0][-1]
+        max_len = max(map(len, rows))
+        if pad_seq_len_divisible:
+            max_len += pad_seq_len_divisible - max_len % pad_seq_len_divisible
+        native = (collate_pad(rows, max_len, int(pad_id))
+                  if np.ndim(rows[0]) == 1 else None)
+        if native is not None:
+            out[key] = native
+        else:
+            padded = [list(r) + [pad_id] * (max_len - len(r)) for r in rows]
+            out[key] = batchify(np.asarray(padded, dtype=np.int32))
     return out
 
 

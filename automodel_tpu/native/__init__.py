@@ -1,0 +1,10 @@
+"""Native (C++) data-plane core, loaded lazily via ctypes.
+
+``lib()`` compiles ``src/packing.cpp`` on first use into a cached shared
+object and returns the ctypes handle, or None when no toolchain is
+available — callers fall back to the Python reference implementations.
+"""
+
+from automodel_tpu.native.build import available, lib
+
+__all__ = ["available", "lib"]

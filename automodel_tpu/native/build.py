@@ -1,0 +1,150 @@
+"""Build + bind the native core.
+
+Compilation happens once per (source hash, compiler) into
+``_build/libampack-<hash>.so`` next to this file; concurrent builders race
+benignly (atomic rename).  No pybind11 in this environment — the ABI is
+plain C called through ctypes (see ``src/packing.cpp``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import shutil
+import subprocess
+import tempfile
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "packing.cpp")
+_BUILD_DIR = os.path.join(_DIR, "_build")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compiler() -> Optional[str]:
+    for cc in (os.environ.get("CXX"), "g++", "clang++"):
+        if cc and shutil.which(cc):
+            return cc
+    return None
+
+
+def _so_path(cc: str) -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read() + cc.encode()).hexdigest()[:16]
+    return os.path.join(_BUILD_DIR, f"libampack-{digest}.so")
+
+
+def _bind(dll: ctypes.CDLL) -> ctypes.CDLL:
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    dll.am_pack_greedy.restype = ctypes.c_int64
+    dll.am_pack_greedy.argtypes = [
+        i32p, ctypes.c_int64, i32p, i32p,
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        i32p, i32p, i32p, i32p, i32p,
+    ]
+    dll.am_collate_pad.restype = ctypes.c_int32
+    dll.am_collate_pad.argtypes = [
+        i32p, i32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, i32p,
+    ]
+    return dll
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The bound native library, or None (no toolchain / build failure)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    cc = _compiler()
+    if cc is None:
+        logger.info("native core disabled: no C++ compiler on PATH")
+        return None
+    so = _so_path(cc)
+    if not os.path.exists(so):
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
+        os.close(fd)
+        cmd = [cc, "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)  # atomic: racing builders converge
+        except Exception as e:
+            logger.warning("native core build failed (%s); using Python "
+                           "fallbacks", e)
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            return None
+    try:
+        _lib = _bind(ctypes.CDLL(so))
+    except OSError as e:
+        logger.warning("native core load failed (%s)", e)
+        return None
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def _i32ptr(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def pack_greedy(lengths, ids, labels, pack_size: int, pad_id: int,
+                ignore_index: int):
+    """numpy front-end for am_pack_greedy; returns a dict of [n_packs, size]
+    int32 arrays plus per-pack sample ``counts``, or None when the native
+    core is unavailable."""
+    import numpy as np
+
+    dll = lib()
+    if dll is None:
+        return None
+    lengths = np.ascontiguousarray(lengths, np.int32)
+    ids = np.ascontiguousarray(ids, np.int32)
+    labels = np.ascontiguousarray(labels, np.int32)
+    null = ctypes.POINTER(ctypes.c_int32)()
+    n = dll.am_pack_greedy(_i32ptr(lengths), len(lengths), _i32ptr(ids),
+                           _i32ptr(labels), pack_size, pad_id, ignore_index,
+                           null, null, null, null, null)
+    if n < 0:
+        raise ValueError(
+            f"sample longer than packed_sequence_size={pack_size}")
+    out = {k: np.empty((n, pack_size), np.int32)
+           for k in ("input_ids", "labels", "position_ids", "segment_ids")}
+    counts = np.empty((n,), np.int32)
+    n2 = dll.am_pack_greedy(
+        _i32ptr(lengths), len(lengths), _i32ptr(ids), _i32ptr(labels),
+        pack_size, pad_id, ignore_index,
+        _i32ptr(out["input_ids"]), _i32ptr(out["labels"]),
+        _i32ptr(out["position_ids"]), _i32ptr(out["segment_ids"]),
+        _i32ptr(counts))
+    assert n2 == n
+    out["counts"] = counts
+    return out
+
+
+def collate_pad(rows, max_len: int, pad_value: int):
+    """Pad a list of int sequences to [n, max_len] int32, or None when the
+    native core is unavailable."""
+    import numpy as np
+
+    dll = lib()
+    if dll is None:
+        return None
+    lengths = np.asarray([len(r) for r in rows], np.int32)
+    flat = (np.concatenate([np.asarray(r, np.int32) for r in rows])
+            if len(rows) else np.empty((0,), np.int32))
+    flat = np.ascontiguousarray(flat)
+    out = np.empty((len(rows), max_len), np.int32)
+    rc = dll.am_collate_pad(_i32ptr(flat), _i32ptr(lengths), len(rows),
+                            max_len, pad_value, _i32ptr(out))
+    if rc != 0:
+        raise ValueError(f"row longer than max_len={max_len}")
+    return out

@@ -1,0 +1,115 @@
+// Native data-plane core: greedy sequence packing + ragged-batch collation.
+//
+// Role: the hot host-side loops of the input pipeline (the reference keeps
+// its data plane on torch's C++ via torchdata/tokenizers; here the packing
+// and padding inner loops are plain C++ behind ctypes, with the Python
+// implementations in automodel_tpu/datasets/ as the semantic reference and
+// fallback).  Single-threaded on purpose: dataloading shares one host core
+// with the dispatch loop, so memory-bandwidth-efficient tight loops beat
+// thread fan-out here.
+//
+// ABI: C, int32 everywhere (token ids and lengths), row-major buffers
+// allocated by the caller (numpy).  Functions return 0 on success.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Greedy no-split packing (semantics of
+// automodel_tpu/datasets/llm/packed_sequence.py:pack with
+// split_across_pack=false): samples are laid out consecutively; a sample
+// that would overflow the current pack starts the next one.  Emits
+// input_ids / labels / position_ids (restarting per sample) / segment_ids
+// (1-based per sample, dense per pack; 0 = padding) and per-pack sample
+// counts.
+//
+// Pass out_* = nullptr to only count packs (first of two calls).
+//
+//   lengths[n_samples]  : token count of each sample
+//   ids, labels         : concatenated sample tokens (sum(lengths))
+//   pack_size           : tokens per pack
+//   pad_id              : fill for input_ids (labels pad with ignore_index)
+//   out_counts          : samples placed into each pack (len n_packs);
+//                         zero-length samples are skipped entirely
+//
+// Returns the number of packs, or -1 if any sample exceeds pack_size.
+int64_t am_pack_greedy(
+    const int32_t* lengths, int64_t n_samples,
+    const int32_t* ids, const int32_t* labels,
+    int64_t pack_size, int32_t pad_id, int32_t ignore_index,
+    int32_t* out_ids, int32_t* out_labels,
+    int32_t* out_pos, int32_t* out_seg, int32_t* out_counts) {
+  int64_t n_packs = 0;
+  int64_t fill = 0;         // tokens used in the current pack
+  int64_t src = 0;          // read offset into ids/labels
+  int32_t seg = 0;          // segments emitted in the current pack
+  const bool write = out_ids != nullptr;
+
+  auto pad_tail = [&](int64_t pack_idx, int64_t from) {
+    if (!write) return;
+    int32_t* ids_row = out_ids + pack_idx * pack_size;
+    int32_t* lab_row = out_labels + pack_idx * pack_size;
+    int32_t* pos_row = out_pos + pack_idx * pack_size;
+    int32_t* seg_row = out_seg + pack_idx * pack_size;
+    for (int64_t i = from; i < pack_size; ++i) {
+      ids_row[i] = pad_id;
+      lab_row[i] = ignore_index;
+      // pad positions keep counting (python packer parity; they are
+      // attention-masked via segment 0 either way)
+      pos_row[i] = static_cast<int32_t>(i);
+      seg_row[i] = 0;
+    }
+  };
+
+  for (int64_t s = 0; s < n_samples; ++s) {
+    const int64_t len = lengths[s];
+    if (len > pack_size) return -1;
+    if (len == 0) continue;            // contributes no tokens, no segment
+    if (fill + len > pack_size) {      // close the current pack
+      pad_tail(n_packs, fill);
+      if (write) out_counts[n_packs] = seg;
+      ++n_packs;
+      fill = 0;
+      seg = 0;
+    }
+    if (write) {
+      int64_t base = n_packs * pack_size + fill;
+      std::memcpy(out_ids + base, ids + src, len * sizeof(int32_t));
+      std::memcpy(out_labels + base, labels + src, len * sizeof(int32_t));
+      for (int64_t i = 0; i < len; ++i) {
+        out_pos[base + i] = static_cast<int32_t>(i);
+        out_seg[base + i] = seg + 1;
+      }
+    }
+    src += len;
+    fill += len;
+    ++seg;
+  }
+  if (fill > 0) {
+    pad_tail(n_packs, fill);
+    if (write) out_counts[n_packs] = seg;
+    ++n_packs;
+  }
+  return n_packs;
+}
+
+// Pad a ragged batch of int32 rows into a [n_rows, max_len] buffer.
+// rows are concatenated in `flat` with `lengths` per row; cells beyond a
+// row's length are `pad_value`.
+int32_t am_collate_pad(
+    const int32_t* flat, const int32_t* lengths, int64_t n_rows,
+    int64_t max_len, int32_t pad_value, int32_t* out) {
+  int64_t src = 0;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    const int64_t len = lengths[r];
+    if (len > max_len) return -1;
+    int32_t* row = out + r * max_len;
+    std::memcpy(row, flat + src, len * sizeof(int32_t));
+    for (int64_t i = len; i < max_len; ++i) row[i] = pad_value;
+    src += len;
+  }
+  return 0;
+}
+
+}  // extern "C"

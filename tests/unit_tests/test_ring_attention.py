@@ -69,3 +69,45 @@ def test_ring_grads_match():
     g_ring = jax.grad(loss_ring)(q, k, v)
     np.testing.assert_allclose(
         np.asarray(g_ring), np.asarray(g_ref), rtol=5e-4, atol=5e-4)
+
+
+def test_tiled_inner_blocks_multi_tile(monkeypatch):
+    """Exercise the cross-tile online-softmax combination: tiny tile edges
+    force nq/nkv > 1 with ragged tails, segments, and gradients."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from automodel_tpu.ops import ring_attention as ra
+    from automodel_tpu.ops.attention import dot_product_attention
+
+    monkeypatch.setattr(ra, "_CQ", 8)
+    monkeypatch.setattr(ra, "_CKV", 8)
+
+    B, S, Hq, Hk, D = 2, 27, 4, 2, 16   # 27 = ragged vs 8-token tiles
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (B, S, Hq, D), jnp.float32)
+    k = jax.random.normal(kk, (B, S, Hk, D), jnp.float32)
+    v = jax.random.normal(kv, (B, S, Hk, D), jnp.float32)
+    seg = np.ones((B, S), np.int32)
+    seg[:, 13:] = 2
+    seg[:, -3:] = 0  # padding
+    seg = jnp.asarray(seg)
+
+    def tiled(q, k, v):
+        qg = q.reshape(B, S, Hk, Hq // Hk, D) * (D ** -0.5)
+        out, m, s = ra._block_attend(qg, k, v, q_offset=0, causal=True,
+                                     seg_q=seg, seg_kv=seg)
+        return (out / jnp.maximum(s, 1e-30)[..., None].transpose(
+            0, 3, 1, 2, 4)).reshape(B, S, Hq, D)
+
+    got = tiled(q, k, v)
+    ref = dot_product_attention(q, k, v, causal=True, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(got)[:, :-3],
+                               np.asarray(ref)[:, :-3], atol=1e-5, rtol=1e-5)
+
+    g1 = jax.grad(lambda q: jnp.sum(tiled(q, k, v) ** 2))(q)
+    g2 = jax.grad(lambda q: jnp.sum(dot_product_attention(
+        q, k, v, causal=True, segment_ids=seg) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g1)[:, :-3],
+                               np.asarray(g2)[:, :-3], atol=1e-4, rtol=1e-4)
