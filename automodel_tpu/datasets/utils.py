@@ -37,16 +37,25 @@ def extract_key_from_dicts(batch: List[dict], key: str) -> List:
     return [x[key] for x in batch]
 
 
-def pad_within_micro(batch: List[List[int]], pad_token_id: Optional[int],
-                     pad_seq_len_divisible: Optional[int] = None) -> List[List[int]]:
-    """Pad each sequence to the longest in the microbatch (optionally rounded
-    up to a divisibility constraint — used for fp8/int8 and TPU lane
-    alignment)."""
+def resolve_pad_geometry(batch: List[List[int]], pad_token_id: Optional[int],
+                         pad_seq_len_divisible: Optional[int] = None):
+    """(max_len, pad_id) — THE padding convention, shared by the Python and
+    native collation paths (and mirrored by ``native/src/packing.cpp``)."""
     max_len = max(map(len, batch))
     if pad_seq_len_divisible:
         max_len = (pad_seq_len_divisible - max_len % pad_seq_len_divisible) + max_len
     if pad_token_id is None:
         pad_token_id = batch[0][-1]
+    return max_len, pad_token_id
+
+
+def pad_within_micro(batch: List[List[int]], pad_token_id: Optional[int],
+                     pad_seq_len_divisible: Optional[int] = None) -> List[List[int]]:
+    """Pad each sequence to the longest in the microbatch (optionally rounded
+    up to a divisibility constraint — used for fp8/int8 and TPU lane
+    alignment)."""
+    max_len, pad_token_id = resolve_pad_geometry(
+        batch, pad_token_id, pad_seq_len_divisible)
     return [list(item) + [pad_token_id] * (max_len - len(item)) for item in batch]
 
 
@@ -95,14 +104,9 @@ def default_collater(batch: List[dict],
     out = {}
     for key in batch[0].keys():
         rows = extract_key_from_dicts(batch, key)
-        # padding convention defined ONCE for both branches (the native
-        # path mirrors pad_within_micro exactly, including its rounding)
-        pad_id = get_pad_token_from_key(key, pad_token_ids)
-        if pad_id is None:
-            pad_id = rows[0][-1]
-        max_len = max(map(len, rows))
-        if pad_seq_len_divisible:
-            max_len += pad_seq_len_divisible - max_len % pad_seq_len_divisible
+        max_len, pad_id = resolve_pad_geometry(
+            rows, get_pad_token_from_key(key, pad_token_ids),
+            pad_seq_len_divisible)
         native = (collate_pad(rows, max_len, int(pad_id))
                   if np.ndim(rows[0]) == 1 else None)
         if native is not None:

@@ -297,6 +297,8 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         # grad-accumulation buffers off fp32 (the fast SFT default in the
         # example YAMLs; fp32 remains the built-in default).
         tr_cfg = cfg.get("training")
+        self._check_for_nan = bool(
+            tr_cfg.get("check_for_nan", True)) if tr_cfg is not None else True
         step_kwargs: Dict[str, Any] = {}
         if tr_cfg is not None and tr_cfg.get("grad_dtype"):
             import jax.numpy as jnp
@@ -494,6 +496,16 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
     def _finalize_metrics(self, pending) -> Dict[str, Any]:
         dm = jax.device_get(pending["device_metrics"])  # one transfer
         dt = time.perf_counter() - pending["t_dispatch"]
+        # NaN/inf guard (the reference's check_for_nan_in_grad role,
+        # ``distributed/parallelizer.py:478``): fail fast instead of
+        # training on garbage; ``training.check_for_nan: false`` disables.
+        if getattr(self, "_check_for_nan", True) and not (
+                np.isfinite(dm["loss"]) and np.isfinite(dm["grad_norm"])):
+            raise FloatingPointError(
+                f"non-finite training signal at step {pending['step']}: "
+                f"loss={float(dm['loss'])}, grad_norm="
+                f"{float(dm['grad_norm'])} (divergence or bad batch; "
+                "set training.check_for_nan: false to continue anyway)")
         out = {
             "loss": float(dm["loss"]),
             "grad_norm": float(dm["grad_norm"]),

@@ -34,8 +34,12 @@ def main() -> None:
     )
 
     overrides = []
+    quant = os.environ.get("BENCH_QUANT", "")     # "" | "int8" | "float8"
+    if quant:
+        overrides += ["--fp8.enabled", "true", "--fp8.dtype", quant,
+                      "--fp8.recipe_name", "tensorwise"]
     if SMALL:
-        overrides = [
+        overrides += [
             "--model.config.hidden_size", "256",
             "--model.config.intermediate_size", "1024",
             "--model.config.num_hidden_layers", "4",

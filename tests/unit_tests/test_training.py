@@ -207,3 +207,27 @@ def test_peak_memory_metric_from_device_stats(monkeypatch):
     out = recipe._finalize_metrics(pending)
     assert out["peak_memory_gb"] == 3.0
     assert out["loss"] == 1.0 and out["step"] == 3
+
+
+def test_nan_guard_raises_on_divergence(tmp_path):
+    import os
+
+    import pytest
+
+    from automodel_tpu.config.arg_parser import parse_args_and_load_config
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
+    )
+
+    yaml_path = os.path.join(os.path.dirname(__file__), "..", "..",
+                             "examples", "llm_finetune", "tiny_llama_mock.yaml")
+    cfg = parse_args_and_load_config(
+        ["--config", yaml_path,
+         "--checkpoint.enabled", "false",
+         "--optimizer.lr", "1e10",   # guaranteed blow-up
+         "--step_scheduler.max_steps", "4"])
+    r = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        for batches in r.step_scheduler:
+            r._run_train_optim_step(batches)
+        r.flush_metrics()
