@@ -93,3 +93,28 @@ def test_recipe_multichip_mesh(tmp_path):
          "--checkpoint.enabled", "false"]).setup()
     recipe.run_train_validation_loop()
     assert recipe.step_scheduler.step == 2
+
+
+def test_recipe_hsdp_tp_sp_packed_composition(tmp_path):
+    """The 70B config's parallelism shape (HSDP replicate x shard x TP with
+    sequence parallelism + packing) at tiny scale on the 8-device mesh —
+    mirrors examples/llm_finetune/llama3_1/llama3_1_70b_hsdp_tp_packed.yaml."""
+    recipe = _make_recipe(
+        tmp_path,
+        ["--distributed.dp_size", "4",
+         "--distributed.dp_replicate_size", "2",
+         "--distributed.tp_size", "2",
+         "--distributed.sequence_parallel", "true",
+         "--packed_sequence.packed_sequence_size", "64",
+         "--max_grad_norm", "1.0",
+         "--training.grad_dtype", "bfloat16",
+         "--step_scheduler.max_steps", "3",
+         "--checkpoint.enabled", "false"]).setup()
+    first = recipe._run_train_optim_step(next(iter(recipe.step_scheduler)))
+    recipe.run_train_validation_loop()
+    recipe.flush_metrics()
+    assert recipe.step_scheduler.step == 3
+    import math
+
+    assert math.isfinite(recipe.last_metrics["loss"])
+    assert recipe.mesh_manager.shape == (2, 2, 1, 2)
