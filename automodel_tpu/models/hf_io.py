@@ -87,6 +87,33 @@ def llama_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
     return m
 
 
+def gemma3_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    """Gemma-3 text (HF ``Gemma3ForCausalLM`` naming — llama-like plus q/k
+    norms and pre/post feedforward norms)."""
+    m: Dict[Tuple[str, ...], HfSpec] = {
+        ("embed_tokens", "embedding"): HfSpec("model.embed_tokens.weight"),
+        ("norm", "weight"): HfSpec("model.norm.weight"),
+    }
+    for norm in ("input_layernorm", "post_attention_layernorm",
+                 "pre_feedforward_layernorm", "post_feedforward_layernorm"):
+        m[("layers", norm, "weight")] = HfSpec(
+            f"model.layers.{{i}}.{norm}.weight", stacked=True)
+    for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+        m[("layers", "self_attn", proj, "kernel")] = HfSpec(
+            f"model.layers.{{i}}.self_attn.{proj}.weight", stacked=True,
+            transpose=True)
+    for norm in ("q_norm", "k_norm"):
+        m[("layers", "self_attn", norm, "weight")] = HfSpec(
+            f"model.layers.{{i}}.self_attn.{norm}.weight", stacked=True)
+    for proj in ("gate_proj", "up_proj", "down_proj"):
+        m[("layers", "mlp", proj, "kernel")] = HfSpec(
+            f"model.layers.{{i}}.mlp.{proj}.weight", stacked=True,
+            transpose=True)
+    if not config.tie_word_embeddings:
+        m[("lm_head", "kernel")] = HfSpec("lm_head.weight", transpose=True)
+    return m
+
+
 def gpt2_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
     # HF GPT-2 uses Conv1D: weights already (in, out) — no transpose.
     m: Dict[Tuple[str, ...], HfSpec] = {
@@ -168,6 +195,27 @@ def vlm_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
             f"multi_modal_projector.{hf}.weight", transpose=True)
         m[("multi_modal_projector", ours, "bias")] = HfSpec(
             f"multi_modal_projector.{hf}.bias")
+    return m
+
+
+def gemma3_vlm_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    """Gemma-3 multimodal (HF ``Gemma3ForConditionalGeneration`` naming:
+    ``model.language_model.*``, ``model.vision_tower.vision_model.*``,
+    ``model.multi_modal_projector.mm_*``)."""
+    m: Dict[Tuple[str, ...], HfSpec] = {}
+    for path, spec in gemma3_key_map(config.text_config).items():
+        # text templates are "model.layers..." / "model.norm..." etc.
+        tpl = spec.template.replace("model.", "model.language_model.", 1)
+        m[("language_model",) + path] = HfSpec(
+            tpl, stacked=spec.stacked, transpose=spec.transpose)
+    for path, spec in vision_key_map(
+            config.vision_config,
+            prefix="model.vision_tower.vision_model.").items():
+        m[("vision_tower",) + path] = spec
+    m[("multi_modal_projector", "mm_input_projection_weight")] = HfSpec(
+        "model.multi_modal_projector.mm_input_projection_weight")
+    m[("multi_modal_projector", "mm_soft_emb_norm", "weight")] = HfSpec(
+        "model.multi_modal_projector.mm_soft_emb_norm.weight")
     return m
 
 

@@ -66,6 +66,20 @@ def _ensure_builtin() -> None:
                                    hf_io.llama_key_map, [arch]))
     register_model(ModelFamily("gpt2", GPT2Config, GPT2LMHeadModel,
                                hf_io.gpt2_key_map, ["GPT2LMHeadModel"]))
+    from automodel_tpu.models.gemma3 import (
+        Gemma3Config,
+        Gemma3ForCausalLM,
+        Gemma3ForConditionalGeneration,
+        Gemma3VLConfig,
+    )
+
+    register_model(ModelFamily("gemma3_text", Gemma3Config, Gemma3ForCausalLM,
+                               hf_io.gemma3_key_map, ["Gemma3ForCausalLM"]))
+    # HF model_type "gemma3" is the MULTIMODAL config (nested text/vision)
+    register_model(ModelFamily("gemma3", Gemma3VLConfig,
+                               Gemma3ForConditionalGeneration,
+                               hf_io.gemma3_vlm_key_map,
+                               ["Gemma3ForConditionalGeneration"]))
     from automodel_tpu.models.vlm import VLMConfig, VLMForConditionalGeneration
 
     register_model(ModelFamily("llava", VLMConfig, VLMForConditionalGeneration,

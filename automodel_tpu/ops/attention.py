@@ -29,17 +29,26 @@ def make_attention_mask(
     segment_ids_kv: Optional[jnp.ndarray] = None,  # [B, Skv]
     padding_mask_kv: Optional[jnp.ndarray] = None,  # [B, Skv] bool/int, 1 = keep
     q_offset: int | jnp.ndarray = 0,
+    local_window_size: Optional[int | jnp.ndarray] = None,
 ) -> Optional[jnp.ndarray]:
     """Boolean mask [B or 1, 1, Sq, Skv]; True = attend.
 
     ``q_offset`` shifts query positions relative to keys — used by ring /
     sharded attention where this host's queries start mid-sequence.
+    ``local_window_size``: sliding-window attention (Gemma3/Mistral style):
+    a query attends keys at most ``window - 1`` positions back.  May be a
+    traced scalar so mixed sliding/full layer stacks stay one scanned
+    program (full layers pass a huge window).
     """
     masks = []
     if causal:
         q_pos = jnp.arange(q_len) + q_offset
         kv_pos = jnp.arange(kv_len)
         masks.append((q_pos[:, None] >= kv_pos[None, :])[None, None])
+        if local_window_size is not None:
+            masks.append(
+                (q_pos[:, None] - kv_pos[None, :]
+                 < local_window_size)[None, None])
     if segment_ids_q is not None and segment_ids_kv is not None:
         seg = segment_ids_q[:, None, :, None] == segment_ids_kv[:, None, None, :]
         # segment id 0 marks padding: never attend to/from it
@@ -80,6 +89,7 @@ def dot_product_attention(
     scale: Optional[float] = None,
     logits_soft_cap: Optional[float] = None,
     q_offset: int | jnp.ndarray = 0,
+    local_window_size: Optional[int | jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Grouped-query SDPA. fp32 softmax, bf16-friendly matmuls (MXU path)."""
     B, Sq, Hq, D = q.shape
@@ -102,6 +112,7 @@ def dot_product_attention(
         segment_ids_kv=segment_ids,
         padding_mask_kv=attention_mask,
         q_offset=q_offset,
+        local_window_size=local_window_size,
     )
     if mask is not None:
         logits = jnp.where(mask[:, :, None], logits, _NEG_INF)
@@ -120,6 +131,7 @@ def cached_attention(
     q_len: int,
     attention_mask: Optional[jnp.ndarray] = None,  # [B, S_max] padding mask
     scale: Optional[float] = None,
+    local_window_size: Optional[int | jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Decode-step attention over a static kv cache.
 
@@ -132,7 +144,8 @@ def cached_attention(
     del q_len  # shape-derived; kept for call-site clarity
     return dot_product_attention(
         q, k_cache, v_cache, causal=True, q_offset=cache_index,
-        attention_mask=attention_mask, scale=scale)
+        attention_mask=attention_mask, scale=scale,
+        local_window_size=local_window_size)
 
 
 def attention(
@@ -145,6 +158,7 @@ def attention(
     attention_mask: Optional[jnp.ndarray] = None,
     scale: Optional[float] = None,
     logits_soft_cap: Optional[float] = None,
+    local_window_size: Optional[int | jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Backend dispatcher — the framework's attention entry point.
 
@@ -165,12 +179,28 @@ def attention(
     if ctx is not None:
         mesh, _rules = ctx
         if "cp" in mesh.shape and mesh.shape["cp"] > 1 and logits_soft_cap is None:
+            # context parallelism keeps precedence over the window path:
+            # the ring's tiled inner blocks apply the window as position
+            # arithmetic, so Gemma3-style stacks stay memory-bounded at cp
+            # scale.
             from automodel_tpu.ops.ring_attention import sharded_ring_attention
 
             seg = fold_padding_into_segments(
                 q.shape[:2], segment_ids, attention_mask)
             return sharded_ring_attention(
-                q, k, v, mesh, causal=causal, segment_ids=seg, scale=scale)
+                q, k, v, mesh, causal=causal, segment_ids=seg, scale=scale,
+                local_window_size=local_window_size)
+
+    if local_window_size is not None:
+        # Sliding-window stacks (Gemma3) run XLA SDPA: the window is a
+        # traced per-layer scalar inside the scanned layer body, which a
+        # static splash mask cannot express (a LocalMask splash path per
+        # static window is a later optimization).
+        return dot_product_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            attention_mask=attention_mask, scale=scale,
+            logits_soft_cap=logits_soft_cap,
+            local_window_size=local_window_size)
 
     try:
         from automodel_tpu.ops.splash_attention import (

@@ -45,7 +45,8 @@ def _ceil_pad(x, mult, axis, value=0.0):
     return jnp.pad(x, widths, constant_values=value)
 
 
-def _block_attend(q, k, v, *, q_offset, causal, seg_q, seg_kv
+def _block_attend(q, k, v, *, q_offset, causal, seg_q, seg_kv,
+                  local_window_size=None
                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One q-block x kv-block attention, double-chunked with online softmax
     (flash-style in XLA): returns (unnormalized out [B,Sq,Hk,G,D], row max
@@ -98,6 +99,9 @@ def _block_attend(q, k, v, *, q_offset, causal, seg_q, seg_kv
             valid = jnp.ones((B, cq, ckv), bool)
             if causal:
                 valid &= (q_pos[:, None] >= kv_pos[None, :])[None]
+            if local_window_size is not None:
+                valid &= (q_pos[:, None] - kv_pos[None, :]
+                          < local_window_size)[None]
             if use_segs:
                 valid &= sqc[:, :, None] == skvc[:, None, :]
                 valid &= (skvc != 0)[:, None, :]
@@ -142,6 +146,7 @@ def ring_attention(
     causal: bool = True,
     segment_ids: Optional[jnp.ndarray] = None,   # [B, S_local]
     scale: Optional[float] = None,
+    local_window_size: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Blockwise ring attention; call inside ``shard_map`` with the sequence
     dim sharded over ``axis_name``.  GQA-native (no kv-head repeat)."""
@@ -161,7 +166,8 @@ def ring_attention(
         # arriving kv block (blocks entirely in the future mask to zero)
         out_b, m_b, s_b = _block_attend(
             qg, k_t, v_t, q_offset=(my_idx - kv_idx) * S, causal=causal,
-            seg_q=segment_ids, seg_kv=seg_t)
+            seg_q=segment_ids, seg_kv=seg_t,
+            local_window_size=local_window_size)
         m_new = jnp.maximum(m_run, m_b)
         alpha = jnp.exp(m_run - m_new)                  # rescale old acc
         beta = jnp.exp(m_b - m_new)
@@ -206,6 +212,7 @@ def sharded_ring_attention(
     causal: bool = True,
     segment_ids=None,
     scale=None,
+    local_window_size=None,
     batch_axes=("dp_replicate", "dp_shard"),
     seq_axis: str = "cp",
     head_axis: str = "tp",
@@ -219,7 +226,8 @@ def sharded_ring_attention(
     sspec = P(tuple(batch_axes), seq_axis)
 
     fn = functools.partial(
-        ring_attention, axis_name=seq_axis, causal=causal, scale=scale)
+        ring_attention, axis_name=seq_axis, causal=causal, scale=scale,
+        local_window_size=local_window_size)
 
     if segment_ids is None:
         def wrapped(q, k, v):

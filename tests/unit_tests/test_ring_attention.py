@@ -111,3 +111,27 @@ def test_tiled_inner_blocks_multi_tile(monkeypatch):
         q, k, v, causal=True, segment_ids=seg) ** 2))(q)
     np.testing.assert_allclose(np.asarray(g1)[:, :-3],
                                np.asarray(g2)[:, :-3], atol=1e-4, rtol=1e-4)
+
+
+def test_ring_sliding_window_matches_sdpa():
+    """Gemma3-style sliding window through the cp ring path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from automodel_tpu.distributed.mesh import MeshManager
+    from automodel_tpu.ops.attention import dot_product_attention
+    from automodel_tpu.ops.ring_attention import sharded_ring_attention
+
+    mm = MeshManager(dp_size=2, cp_size=4)
+    B, S, Hq, Hk, D = 2, 32, 4, 2, 16
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (B, S, Hq, D), jnp.float32)
+    k = jax.random.normal(kk, (B, S, Hk, D), jnp.float32)
+    v = jax.random.normal(kv, (B, S, Hk, D), jnp.float32)
+
+    out = sharded_ring_attention(q, k, v, mm.mesh, causal=True,
+                                 local_window_size=jnp.int32(6))
+    ref = dot_product_attention(q, k, v, causal=True, local_window_size=6)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
