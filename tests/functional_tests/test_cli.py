@@ -1,0 +1,32 @@
+"""CLI end-to-end: ``automodel finetune llm -c cfg.yaml`` dispatch + run."""
+
+import os
+
+import pytest
+
+from automodel_tpu._cli.app import build_parser, main
+
+YAML = os.path.join(os.path.dirname(__file__), "..", "..",
+                    "examples", "llm_finetune", "tiny_llama_mock.yaml")
+
+
+def test_cli_finetune_llm_runs(tmp_path):
+    rc = main(["finetune", "llm", "-c", YAML,
+               "--step_scheduler.max_steps", "2",
+               "--checkpoint.enabled", "false"])
+    assert rc == 0
+
+
+def test_cli_rejects_unknown_verbs():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["evaluate", "llm", "-c", "x.yaml"])
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["finetune", "audio", "-c", "x.yaml"])
+
+
+def test_cli_accepts_reference_compat_flags():
+    args, overrides = build_parser().parse_known_args(
+        ["finetune", "llm", "-c", "cfg.yaml", "--nproc-per-node", "8",
+         "--optimizer.lr", "1e-4"])
+    assert args.nproc_per_node == 8  # accepted, ignored on TPU
+    assert overrides == ["--optimizer.lr", "1e-4"]
