@@ -98,3 +98,28 @@ def test_logits_and_loss_match_transformers(name, tmp_path):
     our_loss = cross_entropy_sum(jnp.asarray(ours)[:, :-1], shifted) / n_tok
     np.testing.assert_allclose(
         float(our_loss), float(out.loss), atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_greedy_generate_matches_transformers(name, tmp_path):
+    """KV-cache decode parity per family variant (GQA, qkv bias, qk norm)."""
+    from automodel_tpu.generation import GenerationConfig, generate
+    from automodel_tpu.models.hf_io import save_hf_weights
+
+    cfg = CASES[name]
+    model = LlamaForCausalLM(cfg, param_dtype=jnp.float32,
+                             compute_dtype=jnp.float32, remat=False)
+    params = _randomized(model, jax.random.key(3))
+    save_hf_weights(model, params, str(tmp_path))
+    hf = transformers.AutoModelForCausalLM.from_pretrained(
+        str(tmp_path), torch_dtype=torch.float32, attn_implementation="eager")
+    hf.eval()
+
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(1, cfg.vocab_size - 1, (1, 9)).astype(np.int64)
+    ours = generate(model, params, prompt,
+                    config=GenerationConfig(max_new_tokens=6))
+    with torch.no_grad():
+        hf_out = hf.generate(torch.from_numpy(prompt), max_new_tokens=6,
+                             do_sample=False, pad_token_id=0)
+    np.testing.assert_array_equal(ours[0], hf_out[0, 9:].numpy())
