@@ -11,12 +11,13 @@ native like :mod:`automodel_tpu.models.llama` with the Gemma-3 specifics:
   per-head q/k norms;
 * GeGLU MLP (tanh-approx gelu on the gate);
 * attention scale ``query_pre_attn_scalar ** -0.5``;
-* alternating sliding-window / full-attention layers: both rope bases
-  (local 10k for sliding, global 1M + linear scaling for full) and the
-  per-layer window ride the layer scan as data, keeping one compiled body.
+* alternating sliding-window / full-attention layers: the per-layer rope
+  base rides the layer scan as data, and the attention call branches with
+  ``lax.cond`` on a per-layer flag so each branch sees a STATIC window —
+  sliding layers hit the splash kernel's LocalMask (off-window blocks
+  skipped), full layers the plain causal kernel, still one scanned body.
 
-Sliding layers route to XLA SDPA (see ``ops/attention.py``); HF round-trip
-parity is pinned by ``tests/unit_tests/test_gemma3_parity.py``.
+HF round-trip parity is pinned by ``tests/unit_tests/test_gemma3_parity.py``.
 """
 
 from __future__ import annotations
@@ -32,9 +33,6 @@ from automodel_tpu.distributed.shardings import constrain
 from automodel_tpu.ops.attention import attention
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.rotary import apply_rope, rope_frequencies
-
-_FULL_WINDOW = 1 << 30  # "no window" as data (full-attention layers)
-
 
 @dataclasses.dataclass
 class Gemma3Config:
@@ -172,7 +170,7 @@ class Gemma3ForCausalLM:
 
     # -- forward -----------------------------------------------------------
     def _layer(self, hidden, p, position_ids, segment_ids, attention_mask,
-               inv_freq, window, kv_cache=None, cache_index=None):
+               inv_freq, is_full, kv_cache=None, cache_index=None):
         cfg = self.config
         B, S, H = hidden.shape
         D, Hq, Hk = cfg.head_dim, cfg.num_attention_heads, cfg.num_key_value_heads
@@ -191,6 +189,20 @@ class Gemma3ForCausalLM:
         k = rms_norm(k, p["self_attn"]["k_norm"]["weight"], eps, offset=1.0)
         q, k = apply_rope(q, k, position_ids, inv_freq)
         scale = float(cfg.query_pre_attn_scalar) ** -0.5
+        scale_ = scale
+        sliding = int(cfg.sliding_window)
+
+        def by_window(fn, *operands, **kwargs):
+            """``is_full`` is a traced per-layer flag; lax.cond gives each
+            branch a STATIC window, so sliding layers hit the splash
+            kernel's LocalMask (off-window blocks skipped) instead of a
+            traced-window SDPA mask."""
+            return lax.cond(
+                is_full,
+                lambda *ops: fn(*ops, **kwargs),
+                lambda *ops: fn(*ops, local_window_size=sliding, **kwargs),
+                *operands)
+
         new_cache = None
         if kv_cache is not None:
             from automodel_tpu.ops.attention import cached_attention
@@ -203,21 +215,19 @@ class Gemma3ForCausalLM:
                 (0, cache_index, 0, 0))
             new_cache = {"k": k_cache, "v": v_cache}
             if S > 1:
-                attn = attention(
-                    q, k, v, causal=True, scale=scale,
+                attn = by_window(
+                    attention, q, k, v, causal=True, scale=scale_,
                     attention_mask=(None if attention_mask is None
-                                    else attention_mask[:, :S]),
-                    local_window_size=window)
+                                    else attention_mask[:, :S]))
             else:
-                attn = cached_attention(
-                    q, k_cache, v_cache, cache_index=cache_index, q_len=S,
-                    attention_mask=attention_mask, scale=scale,
-                    local_window_size=window)
+                attn = by_window(
+                    cached_attention, q, k_cache, v_cache,
+                    cache_index=cache_index, q_len=S,
+                    attention_mask=attention_mask, scale=scale_)
         else:
-            attn = attention(q, k, v, causal=True, scale=scale,
-                             segment_ids=segment_ids,
-                             attention_mask=attention_mask,
-                             local_window_size=window)
+            attn = by_window(
+                attention, q, k, v, causal=True, scale=scale_,
+                segment_ids=segment_ids, attention_mask=attention_mask)
         attn = proj(attn.reshape(B, S, Hq * D), p["self_attn"]["o_proj"])
         attn = rms_norm(attn, p["post_attention_layernorm"]["weight"], eps,
                         offset=1.0)
@@ -270,15 +280,13 @@ class Gemma3ForCausalLM:
         inv_freqs = jnp.where(
             is_full[:, None], jnp.asarray(self.inv_freq_global)[None],
             jnp.asarray(self.inv_freq_local)[None])       # [L, D/2]
-        windows = jnp.where(is_full, _FULL_WINDOW,
-                            cfg.sliding_window).astype(jnp.int32)
 
         decoding = kv_cache is not None
 
         def body(h, xs):
-            layer_params, inv_freq, window, cache = xs
+            layer_params, inv_freq, full_flag, cache = xs
             out = self._layer(h, layer_params, position_ids, segment_ids,
-                              attention_mask, inv_freq, window,
+                              attention_mask, inv_freq, full_flag,
                               kv_cache=cache, cache_index=cache_index)
             if decoding:
                 return out
@@ -291,7 +299,7 @@ class Gemma3ForCausalLM:
                                  None)
             body = jax.checkpoint(body, policy=policy, prevent_cse=False)
         hidden, new_cache = lax.scan(
-            body, hidden, (params["layers"], inv_freqs, windows, kv_cache))
+            body, hidden, (params["layers"], inv_freqs, is_full, kv_cache))
 
         hidden = rms_norm(hidden, params["norm"]["weight"],
                           cfg.rms_norm_eps, offset=1.0)

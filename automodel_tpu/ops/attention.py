@@ -191,11 +191,16 @@ def attention(
                 q, k, v, mesh, causal=causal, segment_ids=seg, scale=scale,
                 local_window_size=local_window_size)
 
-    if local_window_size is not None:
-        # Sliding-window stacks (Gemma3) run XLA SDPA: the window is a
-        # traced per-layer scalar inside the scanned layer body, which a
-        # static splash mask cannot express (a LocalMask splash path per
-        # static window is a later optimization).
+    if local_window_size is not None and not causal:
+        raise NotImplementedError(
+            "local_window_size is defined for causal attention only (the "
+            "window trails the query position)")
+    if local_window_size is not None and not isinstance(
+            local_window_size, int):
+        # TRACED window (e.g. per-layer scalar riding a scan): only SDPA
+        # can express it.  Static int windows fall through to splash, whose
+        # LocalMask skips off-window blocks outright (Gemma3 dispatches
+        # per-layer lax.cond branches with static windows to get here).
         return dot_product_attention(
             q, k, v, causal=causal, segment_ids=segment_ids,
             attention_mask=attention_mask, scale=scale,
@@ -215,11 +220,13 @@ def attention(
                 return sharded_splash_attention(
                     q, k, v, ctx[0], causal=causal, segment_ids=segment_ids,
                     attention_mask=attention_mask, scale=scale,
-                    logits_soft_cap=logits_soft_cap)
+                    logits_soft_cap=logits_soft_cap,
+                    local_window_size=local_window_size)
             return splash_attention_bshd(
                 q, k, v, causal=causal, segment_ids=segment_ids,
                 attention_mask=attention_mask, scale=scale,
-                logits_soft_cap=logits_soft_cap)
+                logits_soft_cap=logits_soft_cap,
+                local_window_size=local_window_size)
     except ImportError:
         # Older JAX without the splash kernel: plain Pallas flash attention
         # (kv heads repeated for GQA) is the secondary TPU path.
@@ -229,8 +236,9 @@ def attention(
             sharded_flash_attention,
         )
 
-        if logits_soft_cap is None and flash_attention_available(
-                q.shape[1], k.shape[1], q.shape[3]):
+        if (logits_soft_cap is None and local_window_size is None
+                and flash_attention_available(
+                    q.shape[1], k.shape[1], q.shape[3])):
             if ctx is not None:
                 return sharded_flash_attention(
                     q, k, v, ctx[0], causal=causal, segment_ids=segment_ids,
@@ -242,4 +250,5 @@ def attention(
     return dot_product_attention(
         q, k, v, causal=causal, segment_ids=segment_ids,
         attention_mask=attention_mask, scale=scale,
-        logits_soft_cap=logits_soft_cap)
+        logits_soft_cap=logits_soft_cap,
+        local_window_size=local_window_size)

@@ -58,7 +58,8 @@ def _pick_block(n: int) -> int:
 @functools.lru_cache(maxsize=64)
 def _build_kernel(q_seq: int, kv_seq: int, q_heads_per_kv: int,
                   causal: bool, soft_cap: Optional[float],
-                  interpret: bool = False):
+                  interpret: bool = False,
+                  local_window: Optional[int] = None):
     """Mask processing runs host-side on numpy and is the expensive part —
     cache the built kernel per (shape, group, mask) signature.
 
@@ -70,8 +71,14 @@ def _build_kernel(q_seq: int, kv_seq: int, q_heads_per_kv: int,
         splash_attention_mask as sm,
     )
 
-    head_mask = (sm.CausalMask((q_seq, kv_seq)) if causal
-                 else sm.FullMask((q_seq, kv_seq)))
+    if local_window is not None:
+        # causal sliding window: attend [q - window + 1, q]; off-window
+        # blocks are skipped outright (Gemma3/Mistral sliding layers)
+        head_mask = sm.LocalMask((q_seq, kv_seq),
+                                 window_size=(local_window - 1, 0), offset=0)
+    else:
+        head_mask = (sm.CausalMask((q_seq, kv_seq)) if causal
+                     else sm.FullMask((q_seq, kv_seq)))
     mask = sm.MultiHeadMask([head_mask for _ in range(q_heads_per_kv)])
     bq, bkv = _pick_block(q_seq), _pick_block(kv_seq)
     # Fused dq+dkv backward (one bwd pass instead of two) with kv-compute
@@ -100,6 +107,7 @@ def splash_attention_bshd(
     attention_mask: Optional[jnp.ndarray] = None,  # [B, Skv] padding mask
     scale: Optional[float] = None,
     logits_soft_cap: Optional[float] = None,
+    local_window_size: Optional[int] = None,   # static int only
 ) -> jnp.ndarray:
     """Splash attention in the framework's [B, S, H, D] convention."""
     from jax.experimental.pallas.ops.tpu.splash_attention import (
@@ -120,7 +128,9 @@ def splash_attention_bshd(
     kernel = _build_kernel(S, Skv, G, causal,
                            None if logits_soft_cap is None
                            else float(logits_soft_cap),
-                           interpret=_INTERPRET)
+                           interpret=_INTERPRET,
+                           local_window=(None if local_window_size is None
+                                         else int(local_window_size)))
 
     # The kernel has no sm_scale param: fold the scale into q.
     qs = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)
@@ -146,6 +156,7 @@ def sharded_splash_attention(
     attention_mask=None,
     scale=None,
     logits_soft_cap=None,
+    local_window_size: Optional[int] = None,
     batch_axes=("dp_replicate", "dp_shard"),
     head_axis: str = "tp",
 ):
@@ -167,7 +178,8 @@ def sharded_splash_attention(
     def inner(q, k, v, seg):
         return splash_attention_bshd(
             q, k, v, causal=causal, segment_ids=seg, scale=scale,
-            logits_soft_cap=logits_soft_cap)
+            logits_soft_cap=logits_soft_cap,
+            local_window_size=local_window_size)
 
     if segment_ids is None:
         return shard_map(

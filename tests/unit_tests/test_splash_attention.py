@@ -75,3 +75,19 @@ def test_gradients_match_sdpa():
     for a, b in zip(gs, gr):
         scale = float(jnp.max(jnp.abs(b))) + 1e-9
         assert float(jnp.max(jnp.abs(a - b))) / scale < 5e-3
+
+
+def test_sliding_window_local_mask():
+    """LocalMask wiring: window w must match SDPA's q - kv < w exactly
+    (discriminates w from w±1)."""
+    q, k, v = _qkv(5)
+    for w in (7, 32):
+        out = sa.splash_attention_bshd(q, k, v, causal=True,
+                                       local_window_size=w)
+        ref = dot_product_attention(q, k, v, causal=True,
+                                    local_window_size=w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3, rtol=2e-3)
+        off = dot_product_attention(q, k, v, causal=True,
+                                    local_window_size=w + 1)
+        assert float(jnp.max(jnp.abs(out - off))) > 1e-2  # w+1 would differ
