@@ -80,3 +80,46 @@ def test_vlm_recipe_multichip_mesh(tmp_path):
     recipe.run_train_validation_loop()
     assert recipe.step_scheduler.step == 2
     assert np.isfinite(recipe.last_metrics["loss"])
+
+
+def test_gemma3_vl_recipe_trains(tmp_path):
+    """The Gemma-3 multimodal family through the full VLM recipe (mock
+    processor configured so placeholder count == mm_tokens_per_image)."""
+    import yaml
+
+    from automodel_tpu.config.loader import ConfigNode
+    from automodel_tpu.recipes.vlm.finetune import FinetuneRecipeForVLM
+
+    with open(YAML) as f:
+        data = yaml.safe_load(f)
+    data["model"] = {
+        "_target_": "automodel_tpu.models.auto_model.build_model",
+        "config": {
+            "model_type": "gemma3",
+            "text_config": {
+                "model_type": "gemma3_text", "vocab_size": 512,
+                "hidden_size": 64, "intermediate_size": 128,
+                "num_hidden_layers": 2, "num_attention_heads": 4,
+                "num_key_value_heads": 2, "head_dim": 16,
+                "query_pre_attn_scalar": 16.0, "sliding_window": 8,
+                "tie_word_embeddings": True},
+            "vision_config": {
+                "hidden_size": 32, "intermediate_size": 64,
+                "num_hidden_layers": 1, "num_attention_heads": 2,
+                "image_size": 32, "patch_size": 16},
+            "mm_tokens_per_image": 4,   # == (32/16)^2 mock placeholders
+            "image_token_index": 7,
+        },
+    }
+    data["checkpoint"] = {"enabled": False}
+    data["step_scheduler"].update(max_steps=3, global_batch_size=16,
+                                  local_batch_size=1)
+    cfg = ConfigNode(data)
+    recipe = FinetuneRecipeForVLM(cfg).setup()
+    first = recipe._run_train_optim_step(next(iter(recipe.step_scheduler)))
+    recipe.run_train_validation_loop()
+    recipe.flush_metrics()
+    import math
+
+    assert math.isfinite(recipe.last_metrics["loss"])
+    assert recipe.step_scheduler.step == 3
