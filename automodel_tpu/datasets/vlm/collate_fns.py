@@ -157,7 +157,12 @@ def phi4_mm_collate_fn(examples: List[dict], processor,
     """Phi-4-multimodal audio path (reference ``collate_fns.py:77-117``):
     the supervised span is located by matching the assistant turn's own
     token ids inside ``input_ids`` (no chat-template response marker), and
-    image-embed side tensors are dropped."""
+    image-embed side tensors are dropped.
+
+    NOTE: no registered model family consumes the audio keys this emits yet;
+    the train step fails loudly on unconsumed batch keys rather than train
+    with the audio context silently dropped — pair this collator with an
+    audio-capable model (``extra_batch_keys``) when one lands."""
     conversations = [ex["conversation"] for ex in examples]
     for conv in conversations:
         if len(conv) < 2 or conv[1].get("role") != "assistant":

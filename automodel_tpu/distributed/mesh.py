@@ -70,11 +70,15 @@ class MeshManager:
         tp_size: int = 1,
         cp_size: int = 1,
         sequence_parallel: bool = False,
+        expert_parallel: bool = False,
         devices: Optional[Sequence[jax.Device]] = None,
         allow_split_physical_axes: bool = True,
         **_unused,
     ):
         self.sequence_parallel = bool(sequence_parallel)
+        # MoE expert placement: experts sharded over the tp axis (EP) vs
+        # TP inside each expert — see ``shardings.default_rules``.
+        self.expert_parallel = bool(expert_parallel)
         devices = list(devices if devices is not None else jax.devices())
         world = len(devices)
 

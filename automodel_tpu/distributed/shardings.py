@@ -50,12 +50,20 @@ MeshAxes = Optional[Tuple[str, ...]]
 Rules = Dict[str, MeshAxes]
 
 
-def default_rules(sequence_parallel: bool = False) -> Rules:
+def default_rules(sequence_parallel: bool = False,
+                  expert_parallel: bool = False) -> Rules:
     """Logical-axis -> mesh-axes table for the FSDP(+HSDP)+TP+CP strategy.
 
     One table replaces the reference's per-model TP plan registry
     (``distributed/optimized_tp_plans.py:235-243``): model families share
     logical names, so a single rule set covers them all.
+
+    ``expert_parallel``: MoE expert placement.  False (default) replicates
+    the expert dim and shards each expert's FFN intermediate over ``tp``
+    (tensor parallelism inside experts); True shards the expert dim itself
+    over ``tp`` (each tp shard owns E/tp experts, GShard-style EP) and keeps
+    the intermediate unsharded — the dispatch/combine einsums then carry the
+    cross-expert collectives.
     """
     rules: Rules = {
         # -- parameter axes --
@@ -69,6 +77,8 @@ def default_rules(sequence_parallel: bool = False) -> Rules:
         "qkv3": (AXIS_TP,),                   # gpt2 fused qkv out
         "mlp": (AXIS_TP,),                    # TP colwise (gate/up out, down in)
         "vocab": (AXIS_TP,),                  # vocab-parallel embedding / lm_head
+        "experts": (AXIS_TP,) if expert_parallel else None,
+        "expert_mlp": None if expert_parallel else (AXIS_TP,),
         # -- activation axes --
         "act_batch": (AXIS_DP_REPLICATE, AXIS_DP_SHARD),
         "act_seq": (AXIS_CP, AXIS_TP) if sequence_parallel else (AXIS_CP,),
@@ -77,6 +87,8 @@ def default_rules(sequence_parallel: bool = False) -> Rules:
         "act_seq_nosp": (AXIS_CP,),
         "act_embed": None,
         "act_vocab": (AXIS_TP,),
+        # MoE merged-token dim: all batch-ish axes (routing is per-token)
+        "act_tokens": (AXIS_DP_REPLICATE, AXIS_DP_SHARD, AXIS_CP),
     }
     return rules
 
@@ -278,6 +290,7 @@ def build_parallel_plan(
     model,
     mesh_manager: Union[MeshManager, Mesh],
     sequence_parallel: Optional[bool] = None,
+    expert_parallel: Optional[bool] = None,
     rules: Optional[Rules] = None,
 ) -> ParallelPlan:
     """The ``FSDP2Manager.parallelize`` equivalent (``distributed/fsdp2.py:223``):
@@ -286,9 +299,12 @@ def build_parallel_plan(
         mesh = mesh_manager.mesh
         if sequence_parallel is None:
             sequence_parallel = mesh_manager.sequence_parallel
+        if expert_parallel is None:
+            expert_parallel = getattr(mesh_manager, "expert_parallel", False)
     else:
         mesh = mesh_manager
-    rules = rules if rules is not None else default_rules(bool(sequence_parallel))
+    rules = rules if rules is not None else default_rules(
+        bool(sequence_parallel), bool(expert_parallel))
     specs = param_partition_specs(model, rules)
     shardings = to_named_shardings(mesh, specs)
     return ParallelPlan(

@@ -38,7 +38,9 @@ SAFETENSORS_INDEX = "model.safetensors.index.json"
 class HfSpec:
     """How one pytree param maps onto HF tensors.
 
-    ``template`` contains ``{i}`` when the param is a stack over layers.
+    ``template`` contains ``{i}`` when the param is a stack over layers, plus
+    ``{e}`` when additionally stacked over experts (``expert_stacked``, MoE:
+    our ``[L, E, ...]`` tree leaf maps onto L x E per-expert HF tensors).
     ``transpose``: HF stores torch Linear as (out, in); our kernel is (in, out).
     ``load_transform``/``save_transform``: arbitrary layout changes (e.g. a
     conv patch-embed kernel (out, C, p, p) <-> our patch matmul (p*p*C, out)).
@@ -49,10 +51,12 @@ class HfSpec:
 
     def __init__(self, template: str, stacked: bool = False,
                  transpose: bool = False,
+                 expert_stacked: bool = False,
                  load_transform: Optional[Callable] = None,
                  save_transform: Optional[Callable] = None):
         self.template = template
         self.stacked = stacked
+        self.expert_stacked = expert_stacked
         self.transpose = transpose
         self.load_transform = load_transform
         self.save_transform = save_transform
@@ -84,6 +88,22 @@ def llama_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
             f"model.layers.{{i}}.mlp.{proj}.weight", stacked=True, transpose=True)
     if not config.tie_word_embeddings:
         m[("lm_head", "kernel")] = HfSpec("lm_head.weight", transpose=True)
+    return m
+
+
+def mixtral_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    """Mixtral (HF ``MixtralForCausalLM`` naming): Llama attention plus
+    ``block_sparse_moe.gate`` and per-expert ``experts.{e}.w1/w2/w3``."""
+    m = llama_key_map(config)
+    for proj in ("gate_proj", "up_proj", "down_proj"):
+        del m[("layers", "mlp", proj, "kernel")]
+    m[("layers", "block_sparse_moe", "gate", "kernel")] = HfSpec(
+        "model.layers.{i}.block_sparse_moe.gate.weight", stacked=True,
+        transpose=True)
+    for w in ("w1", "w2", "w3"):
+        m[("layers", "block_sparse_moe", "experts", w, "kernel")] = HfSpec(
+            f"model.layers.{{i}}.block_sparse_moe.experts.{{e}}.{w}.weight",
+            stacked=True, expert_stacked=True, transpose=True)
     return m
 
 
@@ -228,6 +248,21 @@ def _key_map_for(model) -> Dict[Tuple[str, ...], HfSpec]:
 # ---------------------------------------------------------------------------
 # Reading
 # ---------------------------------------------------------------------------
+# HF multimodal serialization drift: post-refactor transformers nests
+# everything under ``model.`` (``model.language_model.layers...``) while
+# published hub checkpoints (e.g. google/gemma-3-*-it) still carry the legacy
+# flat naming (``language_model.model.layers...``).  Key maps emit the new
+# convention; the checkpoint reader falls back through these renames (the
+# _checkpoint_conversion_mapping role in transformers).
+_LEGACY_KEY_RENAMES = (
+    ("model.language_model.", "language_model.model."),
+    ("model.vision_tower.", "vision_tower."),
+    ("model.multi_modal_projector.", "multi_modal_projector."),
+    ("model.audio_tower.", "audio_tower."),
+    ("model.visual.", "visual."),
+)
+
+
 class _LazyCheckpoint:
     """Lazily-opened safetensors shard set with per-slice reads."""
 
@@ -255,20 +290,44 @@ class _LazyCheckpoint:
                 os.path.join(self.ckpt_dir, fname), framework="numpy")
         return self._handles[fname]
 
+    def resolve(self, key: str) -> str:
+        """Checkpoint name for ``key``, trying legacy<->new renames when the
+        mapped name is absent (loads real hub snapshots, not just our own
+        exports)."""
+        if key in self.weight_map:
+            return key
+        for a, b in _LEGACY_KEY_RENAMES:
+            for pre, alt_pre in ((a, b), (b, a)):
+                if key.startswith(pre):
+                    alt = alt_pre + key[len(pre):]
+                    if alt in self.weight_map:
+                        return alt
+        raise KeyError(
+            f"{key!r} not in checkpoint under {self.ckpt_dir} "
+            "(legacy-name aliases tried too)")
+
     def __contains__(self, key: str) -> bool:
-        return key in self.weight_map
+        try:
+            self.resolve(key)
+            return True
+        except KeyError:
+            return False
 
     def get_slice(self, key: str, idx: Tuple[slice, ...]) -> np.ndarray:
+        key = self.resolve(key)
         sl = self._file(self.weight_map[key]).get_slice(key)
         return sl[idx]
 
     def get(self, key: str) -> np.ndarray:
+        key = self.resolve(key)
         return self._file(self.weight_map[key]).get_tensor(key)
 
 
 def _hf_slice(spec: HfSpec, layer: Optional[int], idx: Tuple[slice, ...],
-              ckpt: _LazyCheckpoint, dtype) -> np.ndarray:
-    key = spec.template.format(i=layer) if spec.stacked else spec.template
+              ckpt: _LazyCheckpoint, dtype,
+              expert: Optional[int] = None) -> np.ndarray:
+    key = (spec.template.format(i=layer, e=expert) if spec.stacked
+           else spec.template)
     if spec.load_transform is not None:
         arr = spec.load_transform(ckpt.get(key))[idx]
     elif spec.transpose:
@@ -310,6 +369,16 @@ def load_hf_weights(
             sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
 
         def cb(idx: Tuple[slice, ...], spec=spec, shape=shape, dtype=dtype):
+            if spec.expert_stacked:
+                l0, l1, _ = idx[0].indices(shape[0])
+                e0, e1, _ = idx[1].indices(shape[1])
+                return np.stack([
+                    np.stack([
+                        _hf_slice(spec, i, idx[2:], ckpt, dtype, expert=e)
+                        for e in range(e0, e1)
+                    ], axis=0)
+                    for i in range(l0, l1)
+                ], axis=0)
             if spec.stacked:
                 lsl = idx[0]
                 start, stop, _ = lsl.indices(shape[0])
@@ -382,7 +451,15 @@ def save_hf_weights(
             # transposed *view* would save the untransposed data.
             return np.ascontiguousarray(arr)
 
-        if spec.stacked:
+        if spec.expert_stacked:
+            per_expert = int(np.prod(value.shape[2:])) * itemsize
+            for i in range(value.shape[0]):
+                for e in range(value.shape[1]):
+                    def expert_fn(v=value, i=i, e=e, spec=spec):
+                        return to_hf(materialize(v[i][e]), spec)
+                    entries.append((spec.template.format(i=i, e=e),
+                                    per_expert, expert_fn))
+        elif spec.stacked:
             per_layer = int(np.prod(value.shape[1:])) * itemsize
             for i in range(value.shape[0]):
                 def layer_fn(v=value, i=i, spec=spec):
@@ -445,6 +522,19 @@ def save_hf_weights(
         multihost_utils.sync_global_devices("hf_save_shards_done")
     if proc != 0:
         return
+    # On a non-shared filesystem, distributed writers leave this host with an
+    # index that names shards it never received — verify the plan landed
+    # before publishing the index (otherwise the corruption is only found at
+    # load time as an opaque safetensors open error).
+    missing = sorted(
+        f for f in set(weight_map.values())
+        if not os.path.exists(os.path.join(out_dir, f)))
+    if missing:
+        raise RuntimeError(
+            f"consolidated HF save incomplete: {len(missing)} planned shard "
+            f"file(s) missing from {out_dir} (e.g. {missing[0]}); if the "
+            "output directory is not on a filesystem shared by all hosts, "
+            "pass distribute_writes=False so process 0 writes every shard")
     with open(os.path.join(out_dir, SAFETENSORS_INDEX), "w") as f:
         json.dump(
             {"metadata": {"total_size": total}, "weight_map": weight_map},

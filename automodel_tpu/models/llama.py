@@ -152,11 +152,7 @@ class LlamaForCausalLM:
                 "input_layernorm": {"weight": ones((L, H))},
                 "self_attn": attn,
                 "post_attention_layernorm": {"weight": ones((L, H))},
-                "mlp": {
-                    "gate_proj": {"kernel": dense(next(keys), (H, I))},
-                    "up_proj": {"kernel": dense(next(keys), (H, I))},
-                    "down_proj": {"kernel": dense(next(keys), (I, H))},
-                },
+                **self._init_ffn(keys, dense),
             },
             "norm": {"weight": ones((H,))},
         }
@@ -169,6 +165,28 @@ class LlamaForCausalLM:
 
             params = quantize_base_params(params)
         return params
+
+    def _init_ffn(self, keys, dense) -> Dict[str, Any]:
+        """Per-layer feed-forward param subtree; MoE families override (so
+        the dense MLP stack is never materialized for routed models)."""
+        cfg = self.config
+        H, I = cfg.hidden_size, cfg.intermediate_size
+        return {
+            "mlp": {
+                "gate_proj": {"kernel": dense(next(keys), (H, I))},
+                "up_proj": {"kernel": dense(next(keys), (H, I))},
+                "down_proj": {"kernel": dense(next(keys), (I, H))},
+            },
+        }
+
+    def _ffn_axes(self) -> Dict[str, Any]:
+        return {
+            "mlp": {
+                "gate_proj": {"kernel": ("layers", "embed", "mlp")},
+                "up_proj": {"kernel": ("layers", "embed", "mlp")},
+                "down_proj": {"kernel": ("layers", "mlp", "embed")},
+            },
+        }
 
     def abstract_params(self) -> Dict[str, Any]:
         return jax.eval_shape(self.init, jax.random.key(0))
@@ -197,11 +215,7 @@ class LlamaForCausalLM:
                 "input_layernorm": {"weight": ("layers", "norm")},
                 "self_attn": attn,
                 "post_attention_layernorm": {"weight": ("layers", "norm")},
-                "mlp": {
-                    "gate_proj": {"kernel": ("layers", "embed", "mlp")},
-                    "up_proj": {"kernel": ("layers", "embed", "mlp")},
-                    "down_proj": {"kernel": ("layers", "mlp", "embed")},
-                },
+                **self._ffn_axes(),
             },
             "norm": {"weight": ("norm",)},
         }
@@ -312,16 +326,28 @@ class LlamaForCausalLM:
                     "self_attn.o_proj")
         hidden = resid + attn
 
-        # MLP block (SwiGLU)
+        # MLP block (dense SwiGLU here; MoE families override ``_mlp_block``)
         resid = hidden
         x = rms_norm(hidden, p["post_attention_layernorm"]["weight"], cfg.rms_norm_eps)
+        down, moe_aux = self._mlp_block(x, p, proj)
+        # SP/CP activation layout between blocks (no-op without a sharding ctx)
+        out = constrain(resid + down, ("act_batch", "act_seq", "act_embed"))
+        return out, new_cache, moe_aux
+
+    def _combine_aux(self, aux_losses):
+        """Fold per-layer aux ys (stacked over L by the scan) into the
+        scalar ``aux_loss`` output; MoE families override."""
+        return jnp.mean(aux_losses)
+
+    def _mlp_block(self, x, p, proj):
+        """Post-norm feed-forward of one layer -> ``(out, aux|None)``.
+        The seam MoE families replace (routed experts return per-layer
+        routing stats for the load-balancing aux loss; dense returns None)."""
         gate = proj(x, p["mlp"]["gate_proj"], "mlp.gate_proj")
         up = proj(x, p["mlp"]["up_proj"], "mlp.up_proj")
         down = proj(jax.nn.silu(gate) * up, p["mlp"]["down_proj"],
                     "mlp.down_proj")
-        # SP/CP activation layout between blocks (no-op without a sharding ctx)
-        out = constrain(resid + down, ("act_batch", "act_seq", "act_embed"))
-        return (out, new_cache) if kv_cache is not None else out
+        return down, None
 
     def __call__(
         self,
@@ -413,24 +439,21 @@ class LlamaForCausalLM:
             layer_params, ad, idx, cache = xs
             rng = (jax.random.fold_in(dropout_rng, idx)
                    if dropout_rng is not None else None)
-            out = self._decoder_layer(
+            h, new_cache, aux = self._decoder_layer(
                 h, layer_params, position_ids, segment_ids, attention_mask,
                 inv_freq, adapters=ad, adapter_scale=adapter_scale,
                 adapter_dropout=adapter_dropout,
                 dropout_position=adapter_dropout_position, dropout_rng=rng,
                 kv_cache=cache, cache_index=cache_index,
             )
-            if decoding:
-                h, new_cache = out
-                return h, new_cache
-            return out, None
+            return h, (new_cache, aux)
 
         if self.remat and not decoding:
             policy = None
             if self.remat_policy and self.remat_policy != "none":
                 policy = getattr(jax.checkpoint_policies, self.remat_policy, None)
             body = jax.checkpoint(body, policy=policy, prevent_cse=False)
-        hidden, new_cache = lax.scan(
+        hidden, (new_cache, aux_losses) = lax.scan(
             body, hidden,
             (params["layers"], layer_adapters, layer_idx, kv_cache))
 
@@ -441,10 +464,13 @@ class LlamaForCausalLM:
             else params["lm_head"]["kernel"]
         )
         if return_hidden:
-            return {"hidden_states": hidden, "lm_head_kernel": lm_kernel}
-        logits = hidden @ lm_kernel.astype(self.compute_dtype)
-        out = {"logits": constrain(logits,
-                                   ("act_batch", "act_seq_nosp", "act_vocab"))}
+            out = {"hidden_states": hidden, "lm_head_kernel": lm_kernel}
+        else:
+            logits = hidden @ lm_kernel.astype(self.compute_dtype)
+            out = {"logits": constrain(
+                logits, ("act_batch", "act_seq_nosp", "act_vocab"))}
+        if aux_losses is not None:
+            out["aux_loss"] = self._combine_aux(aux_losses)
         if decoding:
             out["kv_cache"] = new_cache
         return out

@@ -1,0 +1,128 @@
+"""Mixtral-family sparse-MoE decoder (Mixtral 8x7B/8x22B shapes).
+
+The reference fine-tunes Mixtral through HF transformers
+(``nemo_automodel/components/_transformers/auto_model.py:384``; its own
+functional CI trains a 2-layer Mixtral,
+``tests/functional_tests/hf_transformer_llm/L2_HF_Transformer_LLM_FSDP2_TP2.sh:18-38``).
+Here the family is native: the Llama scan-stacked decoder with the dense
+SwiGLU swapped for the dispatch/combine expert block in
+``automodel_tpu/ops/moe.py`` — expert weights stacked ``[L, E, ...]`` so one
+compiled layer body covers every layer, and the expert dim carries a logical
+``experts`` axis the sharding rules can map to the mesh (expert parallelism).
+
+Routing semantics and the load-balancing aux loss match
+``transformers.models.mixtral.modeling_mixtral`` (fp32 softmax -> top-k ->
+renormalize; Switch aux loss scaled by ``router_aux_loss_coef``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from automodel_tpu.ops.moe import moe_mlp_block
+
+
+@dataclasses.dataclass
+class MixtralConfig(LlamaConfig):
+    """HF ``MixtralConfig`` field names on top of the Llama superset."""
+
+    num_local_experts: int = 8
+    num_experts_per_tok: int = 2
+    router_aux_loss_coef: float = 0.02
+    output_router_logits: bool = False
+    # TPU-side knobs (not HF fields): GShard capacity semantics.  None means
+    # lossless (capacity = group size, exact HF parity); see ops/moe.py.
+    moe_capacity_factor: Optional[float] = 2.0
+    moe_group_size: int = 512
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.model_type = "mixtral"
+
+
+class MixtralForCausalLM(LlamaForCausalLM):
+    """Llama decoder with the MLP replaced by routed experts.
+
+    Param tree adds, per layer (stacked over ``L``):
+      ``block_sparse_moe/gate/kernel``        [L, H, E]
+      ``block_sparse_moe/experts/w1/kernel``  [L, E, H, I]  (gate proj)
+      ``block_sparse_moe/experts/w3/kernel``  [L, E, H, I]  (up proj)
+      ``block_sparse_moe/experts/w2/kernel``  [L, E, I, H]  (down proj)
+    (w1/w2/w3 keep the HF expert-module names so the key map stays 1:1.)
+    """
+
+    def _init_ffn(self, keys, dense):
+        cfg = self.config
+        H, I, E = cfg.hidden_size, cfg.intermediate_size, cfg.num_local_experts
+        return {
+            "block_sparse_moe": {
+                "gate": {"kernel": dense(next(keys), (H, E))},
+                "experts": {
+                    "w1": {"kernel": dense(next(keys), (E, H, I))},
+                    "w3": {"kernel": dense(next(keys), (E, H, I))},
+                    "w2": {"kernel": dense(next(keys), (E, I, H))},
+                },
+            },
+        }
+
+    def _ffn_axes(self):
+        return {
+            "block_sparse_moe": {
+                "gate": {"kernel": ("layers", "embed", None)},
+                "experts": {
+                    "w1": {"kernel": ("layers", "experts", "embed", "expert_mlp")},
+                    "w3": {"kernel": ("layers", "experts", "embed", "expert_mlp")},
+                    "w2": {"kernel": ("layers", "experts", "expert_mlp", "embed")},
+                },
+            },
+        }
+
+    def _mlp_block(self, x, p, proj):
+        cfg = self.config
+        moe = p["block_sparse_moe"]
+        return moe_mlp_block(
+            x,
+            moe["gate"]["kernel"],
+            moe["experts"]["w1"]["kernel"],
+            moe["experts"]["w3"]["kernel"],
+            moe["experts"]["w2"]["kernel"],
+            num_experts_per_tok=cfg.num_experts_per_tok,
+            capacity_factor=cfg.moe_capacity_factor,
+            group_size=cfg.moe_group_size,
+            compute_dtype=self.compute_dtype,
+        )
+
+    def _combine_aux(self, aux_losses):
+        """HF ``load_balancing_loss_func`` over all layers: it concatenates
+        every layer's tokens before the ``E * sum f*P`` product, which equals
+        averaging the per-layer routing stats FIRST (mean of products would
+        be wrong).  Returns the coef-scaled penalty, or 0 when
+        ``output_router_logits`` is off (HF routes but applies no penalty)."""
+        from automodel_tpu.ops.moe import load_balancing_loss
+
+        cfg = self.config
+        coef = float(cfg.router_aux_loss_coef)
+        if not cfg.output_router_logits or coef == 0.0:
+            return jnp.float32(0.0)
+        tokens_per_expert, router_prob = aux_losses     # [L, k, E], [L, E]
+        return jnp.float32(coef) * load_balancing_loss(
+            jnp.mean(tokens_per_expert, axis=0), jnp.mean(router_prob, axis=0))
+
+    def flops_per_token(self) -> float:
+        """Fwd+bwd matmul FLOPs/token: attention as Llama, FFN counted at
+        ``k`` active experts per token plus the router."""
+        cfg = self.config
+        attn = (
+            2 * cfg.hidden_size
+            * (cfg.num_attention_heads + 2 * cfg.num_key_value_heads)
+            * cfg.head_dim
+            + 2 * cfg.num_attention_heads * cfg.head_dim * cfg.hidden_size
+        )
+        ffn = cfg.num_experts_per_tok * 6 * cfg.hidden_size * cfg.intermediate_size
+        router = 2 * cfg.hidden_size * cfg.num_local_experts
+        embed = 2 * cfg.vocab_size * cfg.hidden_size
+        return 3.0 * (cfg.num_hidden_layers * (attn + ffn + router) + embed)

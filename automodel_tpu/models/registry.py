@@ -66,6 +66,10 @@ def _ensure_builtin() -> None:
                                    hf_io.llama_key_map, [arch]))
     register_model(ModelFamily("gpt2", GPT2Config, GPT2LMHeadModel,
                                hf_io.gpt2_key_map, ["GPT2LMHeadModel"]))
+    from automodel_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+    register_model(ModelFamily("mixtral", MixtralConfig, MixtralForCausalLM,
+                               hf_io.mixtral_key_map, ["MixtralForCausalLM"]))
     from automodel_tpu.models.gemma3 import (
         Gemma3Config,
         Gemma3ForCausalLM,

@@ -1,0 +1,142 @@
+"""Mixture-of-experts routing + expert compute, the TPU way.
+
+What the reference gets from HF transformers' ``MixtralSparseMoeBlock``
+(eager per-expert gather/scatter driven by ``torch.where`` — fine on GPU,
+shape-dynamic and serial) is here the GShard/Switch dispatch-combine
+formulation: routing builds **static-shape** dispatch/combine tensors and
+expert FFNs run as one batched einsum over the expert dim, so the MXU sees
+E large matmuls and XLA can shard the expert dim over the mesh
+(expert parallelism) with compile-time collectives.
+
+Parity target: ``transformers`` Mixtral routing semantics
+(``modeling_mixtral.py``: softmax over all experts in fp32 -> top-k ->
+renormalize) and its ``load_balancing_loss_func``.  With sufficient capacity
+the dispatch-combine result is exactly the reference's dropless computation;
+under a finite ``capacity_factor`` tokens over capacity are dropped
+(GShard semantics) — the residual stream passes them through unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from automodel_tpu.distributed.shardings import constrain
+
+
+def topk_routing(router_logits: jnp.ndarray, k: int
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """HF Mixtral routing: fp32 softmax over all experts, top-k, renormalize.
+
+    Returns ``(weights [..., k], expert_idx [..., k], probs [..., E])``.
+    """
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    weights, idx = lax.top_k(probs, k)
+    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    return weights, idx, probs
+
+
+def routing_stats(probs: jnp.ndarray, expert_idx: jnp.ndarray,
+                  num_experts: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-call routing statistics for the Switch aux loss:
+    ``(tokens_per_expert [k, E], router_prob [E])``, means over tokens.
+
+    Kept separate from the loss product because HF's
+    ``load_balancing_loss_func`` concatenates ALL layers' tokens before the
+    ``sum_e f_e * P_e`` product — so multi-layer callers must average the
+    stats across layers first (mean of products != product of means)."""
+    mask = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.float32)
+    token_axes = tuple(range(mask.ndim - 2))        # all but (k, E)
+    tokens_per_expert = jnp.mean(mask, axis=token_axes)          # [k, E]
+    router_prob = jnp.mean(probs.astype(jnp.float32),
+                           axis=tuple(range(probs.ndim - 1)))    # [E]
+    return tokens_per_expert, router_prob
+
+
+def load_balancing_loss(tokens_per_expert: jnp.ndarray,
+                        router_prob: jnp.ndarray) -> jnp.ndarray:
+    """``E * sum_{k,e} f_{k,e} * P_e`` (HF ``load_balancing_loss_func``)."""
+    num_experts = router_prob.shape[-1]
+    return jnp.sum(tokens_per_expert * router_prob[None, :]) * num_experts
+
+
+def _group_size(tokens: int, requested: int) -> int:
+    """Largest divisor of ``tokens`` that is <= requested (dispatch tensors
+    are sized per group, so groups bound routing memory)."""
+    m = min(requested, tokens)
+    while tokens % m:
+        m -= 1
+    return m
+
+
+def moe_mlp_block(
+    x: jnp.ndarray,                 # [B, S, H]
+    gate_kernel: jnp.ndarray,       # [H, E]
+    w_gate: jnp.ndarray,            # [E, H, I]  (HF mixtral w1)
+    w_up: jnp.ndarray,              # [E, H, I]  (HF mixtral w3)
+    w_down: jnp.ndarray,            # [E, I, H]  (HF mixtral w2)
+    *,
+    num_experts_per_tok: int,
+    capacity_factor: Optional[float] = 2.0,
+    group_size: int = 512,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Top-k routed SwiGLU expert FFN.  Returns ``(out [B, S, H],
+    (tokens_per_expert [k, E], router_prob [E]))`` — see
+    :func:`routing_stats` for how to fold the stats into the aux loss.
+
+    ``capacity_factor=None`` means lossless: per-group expert capacity is the
+    group size itself, so no assignment can overflow — exact HF parity at
+    E/k x the minimal expert FLOPs.  The finite default (2.0) is the
+    standard train-time trade: capacity ``C = ceil(k*M/E * cf)``.
+    """
+    B, S, H = x.shape
+    E = gate_kernel.shape[-1]
+    k = int(num_experts_per_tok)
+    cd = compute_dtype
+    T = B * S
+    M = _group_size(T, group_size)
+    G = T // M
+    if capacity_factor is None:
+        C = M
+    else:
+        C = min(M, max(int(math.ceil(k * M / E * float(capacity_factor))), 1))
+
+    xg = x.reshape(G, M, H)
+    # Token dim gathers every batch-ish mesh axis (dp x cp): routing is
+    # per-token, so the merged [B*S] layout keeps dispatch local to shards.
+    xg = constrain(xg, ("act_tokens", None, None))
+
+    # Router in fp32 (HF computes gating in float32 for stability).
+    router_logits = xg.astype(jnp.float32) @ gate_kernel.astype(jnp.float32)
+    weights, idx, probs = topk_routing(router_logits, k)        # [G, M, k]
+    aux = routing_stats(probs, idx, E)
+
+    # Dispatch/combine build, slot-major priority (GShard): slot j's
+    # assignments claim capacity after all slots < j.
+    dispatch = jnp.zeros((G, M, E, C), cd)
+    combine = jnp.zeros((G, M, E, C), cd)
+    counts = jnp.zeros((G, 1, E), jnp.int32)
+    for j in range(k):
+        oh = jax.nn.one_hot(idx[..., j], E, dtype=jnp.int32)    # [G, M, E]
+        pos = jnp.cumsum(oh, axis=1) - oh + counts              # [G, M, E]
+        counts = counts + jnp.sum(oh, axis=1, keepdims=True)
+        keep = (oh * (pos < C)).astype(cd)                      # [G, M, E]
+        d = keep[..., None] * jax.nn.one_hot(pos, C, dtype=cd)  # [G, M, E, C]
+        dispatch = dispatch + d
+        combine = combine + weights[..., j, None, None].astype(cd) * d
+
+    # Expert-batched FFN: E leading so the expert dim can shard (EP).
+    expert_in = jnp.einsum("gmec,gmh->egch", dispatch, xg.astype(cd))
+    expert_in = constrain(expert_in, ("experts", "act_tokens", None, None))
+    h_gate = jnp.einsum("egch,ehi->egci", expert_in, w_gate.astype(cd))
+    h_up = jnp.einsum("egch,ehi->egci", expert_in, w_up.astype(cd))
+    h_act = jax.nn.silu(h_gate) * h_up
+    expert_out = jnp.einsum("egci,eih->egch", h_act, w_down.astype(cd))
+    expert_out = constrain(expert_out, ("experts", "act_tokens", None, None))
+    out = jnp.einsum("egch,gmec->gmh", expert_out, combine)
+    return out.reshape(B, S, H), aux

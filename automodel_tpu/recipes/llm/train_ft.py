@@ -149,8 +149,11 @@ def build_lr_scheduler(cfg_lr: Optional[ConfigNode],
         start_wd=wd, end_wd=wd, wd_incr_steps=0, wd_incr_style="constant",
     )
     if cfg_lr is not None:
+        # None-valued keys mean "unset" (keep the derived default) — so
+        # ``--lr_scheduler.lr_decay_steps null`` falls back to the
+        # epochs-derived horizon instead of passing None through.
         overrides = {k: v for k, v in cfg_lr.to_dict().items()
-                     if k != "_target_"}
+                     if k != "_target_" and v is not None}
         defaults.update(overrides)
     return OptimizerParamScheduler(**defaults)
 
@@ -357,7 +360,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             dp_size=self.mesh_manager.dp_size,
             local_batch_size=local_bs,
             dataloader=self.dataloader, **ss_kwargs)
-        total = ss_kwargs.get("max_steps") or 1000
+        total = self._total_optim_steps(ss_kwargs)
         self.lr_scheduler = build_lr_scheduler(
             cfg.get("lr_scheduler"), cfg.get("optimizer"), total)
 
@@ -369,6 +372,24 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         # resume if a checkpoint exists
         self.load_checkpoint()
         return self
+
+    def _total_optim_steps(self, ss_kwargs: Dict[str, Any]) -> int:
+        """LR-decay horizon: ``max_steps`` when set, else epochs x
+        steps-per-epoch from the dataloader length (the reference derives it
+        from the scheduler, ``train_ft.py:350-380``) — an epochs-driven run
+        must not decay over an arbitrary 1000-step horizon."""
+        if ss_kwargs.get("max_steps"):
+            return int(ss_kwargs["max_steps"])
+        sched = self.step_scheduler
+        try:
+            steps_per_epoch = len(self.dataloader) // sched.grad_acc_steps
+        except TypeError:  # iterable dataset without a length
+            logger.warning(
+                "lr horizon: no max_steps and the dataloader has no length; "
+                "defaulting lr_decay_steps to 1000 — set "
+                "step_scheduler.max_steps or lr_scheduler.lr_decay_steps")
+            return 1000
+        return max(steps_per_epoch * max(sched.num_epochs, 1), 1)
 
     # -- overridable setup hooks (the VLM recipe swaps these) ---------------
     def _build_freeze_mask(self):
@@ -410,6 +431,13 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                     "hosts must collate to identical [B_local, S] shapes — "
                     "set packed_sequence.packed_sequence_size or "
                     "dataset.seq_length to guarantee a fixed S")
+        # Unpacked training batches pad to multiples of 128 by default: the
+        # splash-attention fast path needs S % 128 == 0 (ops/splash_attention
+        # .py:38-48), and without this the user-facing unpacked recipes fell
+        # back to XLA SDPA while only the packed bench config hit the kernel.
+        if (not int(cfg.get("packed_sequence.packed_sequence_size", 0) or 0)
+                and "dataloader.pad_seq_len_divisible" not in cfg):
+            cfg.set_by_dotted("dataloader.pad_seq_len_divisible", 128)
         self.dataloader = build_dataloader(
             cfg, dataset, "dataloader",
             local_batch_size=global_mb, seed=self.rng.seed,
@@ -580,6 +608,9 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                             self.wandb.log({"val_loss": val_loss},
                                            step=sched.step)
                 if sched.is_ckpt_step and self.checkpoint_config.enabled:
+                    # Drain the in-flight step first so its NaN guard runs
+                    # before the params it produced are persisted.
+                    self.flush_metrics()
                     self.save_checkpoint(epoch, sched.step)
                     self._last_ckpt_step = sched.step
             self.flush_metrics()

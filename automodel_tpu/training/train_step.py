@@ -37,16 +37,38 @@ from automodel_tpu.distributed.shardings import (
 )
 from automodel_tpu.loss.masked_ce import IGNORE_INDEX, MaskedCrossEntropy
 
-# Keys the model forward consumes; anything else in a batch is ignored.
+# Keys the model forward consumes; models with extra modalities extend this
+# via an ``extra_batch_keys`` attribute (e.g. Qwen2.5-VL's image_grid_thw).
 _MODEL_KEYS = ("input_ids", "position_ids", "segment_ids", "attention_mask",
                "pixel_values")
+# Keys the step itself consumes outside the model forward.
+_STEP_KEYS = ("labels", "dropout_rng")
+
+
+def _model_keys(model) -> Tuple[str, ...]:
+    return _MODEL_KEYS + tuple(getattr(model, "extra_batch_keys", ()))
 
 
 def _microbatch_loss(model, loss_fn, params, mb: Dict[str, jnp.ndarray]):
     """Sum-CE of one microbatch. Routes the fused-linear-CE path when the
     loss wants hidden states (reference ``calculate_loss`` routing,
     ``train_ft.py:425-474``)."""
-    kwargs = {k: mb[k] for k in _MODEL_KEYS[1:] if mb.get(k) is not None}
+    model_keys = _model_keys(model)
+    # Fail loudly on batch keys nothing consumes: a collator emitting e.g.
+    # audio embeddings for a model without an audio path would otherwise
+    # train with that context silently dropped (supervising answers whose
+    # inputs are missing).  Keys are static under jit, so this is trace-time.
+    unconsumed = set(mb) - set(model_keys) - set(_STEP_KEYS)
+    if unconsumed:
+        raise ValueError(
+            f"batch keys {sorted(unconsumed)} are not consumed by "
+            f"{type(model).__name__} (accepts {sorted(model_keys)}) nor by "
+            "the train step — training would silently supervise answers "
+            "whose inputs were dropped. Use a model family that implements "
+            "this modality (a model declares extra inputs via "
+            "`extra_batch_keys`), or a collator that does not emit these "
+            "keys.")
+    kwargs = {k: mb[k] for k in model_keys[1:] if mb.get(k) is not None}
     if mb.get("dropout_rng") is not None:
         # [2] uint32 key data per microbatch (LoRA dropout; see the recipe's
         # _device_batch) — absent at eval, so dropout is train-only.
@@ -54,9 +76,18 @@ def _microbatch_loss(model, loss_fn, params, mb: Dict[str, jnp.ndarray]):
     labels = mb["labels"]
     if getattr(loss_fn, "needs_hidden", False):
         out = model(params, mb["input_ids"], return_hidden=True, **kwargs)
-        return loss_fn(out["hidden_states"], out["lm_head_kernel"], labels)
-    out = model(params, mb["input_ids"], **kwargs)
-    return loss_fn(out["logits"], labels)
+        loss = loss_fn(out["hidden_states"], out["lm_head_kernel"], labels)
+    else:
+        out = model(params, mb["input_ids"], **kwargs)
+        loss = loss_fn(out["logits"], labels)
+    if "aux_loss" in out:
+        # MoE load-balancing penalty (already coef-scaled by the model).
+        # The step divides every microbatch's sum by the global label-token
+        # count, so scaling by this microbatch's count makes the final loss
+        # CE_mean + token-weighted-mean(aux) — HF's ``loss + coef * aux``.
+        n_mb = jnp.sum(labels != IGNORE_INDEX).astype(loss.dtype)
+        loss = loss + out["aux_loss"].astype(loss.dtype) * n_mb
+    return loss
 
 
 @dataclasses.dataclass

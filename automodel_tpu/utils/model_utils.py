@@ -52,7 +52,11 @@ def make_freeze_mask(
     regexes + arbitrary patterns)."""
     patterns = list(freeze_patterns or [])
     if freeze_embeddings:
-        patterns.append(r".*(embed|wte|wpe).*")
+        # Token/position embedding *modules* only (reference freezes
+        # ``nn.Embedding`` instances, ``vlm/finetune.py:70-89``) — anchored on
+        # whole path segments so a vision tower's patch_embed/pos_embed
+        # projections stay trainable.
+        patterns.append(r"(?:.*\.)?(?:embed_tokens|wte|wpe)(?:\..*)?")
     if freeze_vision_tower:
         patterns.append(r".*(vision_tower|vision_model).*")
     if freeze_language_model:

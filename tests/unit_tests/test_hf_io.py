@@ -87,6 +87,76 @@ def test_aux_files_copied_into_export(model, tmp_path):
     assert not (out / "pytorch_model.bin").exists()
 
 
+def test_legacy_flat_vlm_naming_loads(tmp_path):
+    """Published Gemma-3 multimodal hub snapshots use the legacy flat naming
+    (``language_model.model.*``, ``vision_tower.*``); our key map emits the
+    post-refactor nested names — the loader must fall back through the
+    rename aliases (ADVICE r2 medium)."""
+    import jax.numpy as jnp
+    from safetensors.numpy import save_file
+
+    from automodel_tpu.models.gemma3 import (
+        Gemma3ForConditionalGeneration,
+        Gemma3VLConfig,
+    )
+
+    vl_cfg = Gemma3VLConfig(
+        text_config=dict(
+            vocab_size=260, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=8),
+        vision_config=dict(hidden_size=32, intermediate_size=64,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           image_size=32, patch_size=8, num_channels=3),
+        mm_tokens_per_image=4, image_token_index=259,
+        boi_token_index=257, eoi_token_index=258)
+    vlm = Gemma3ForConditionalGeneration(
+        vl_cfg, param_dtype=jnp.float32, compute_dtype=jnp.float32,
+        remat=False)
+    params = vlm.init(jax.random.key(0))
+    save_hf_weights(vlm, params, str(tmp_path / "new"))
+
+    # Rewrite the export with hub-style legacy names.
+    def legacy(key: str) -> str:
+        if key.startswith("model.language_model."):
+            return "language_model.model." + key[len("model.language_model."):]
+        return key.removeprefix("model.")
+
+    legacy_dir = tmp_path / "legacy"
+    legacy_dir.mkdir()
+    idx = json.load(open(tmp_path / "new" / "model.safetensors.index.json"))
+    weight_map = {}
+    for fname in sorted(set(idx["weight_map"].values())):
+        with safe_open(str(tmp_path / "new" / fname), framework="numpy") as f:
+            tensors = {legacy(k): f.get_tensor(k) for k in f.keys()}
+        save_file(tensors, str(legacy_dir / fname), metadata={"format": "pt"})
+        weight_map.update({k: fname for k in tensors})
+    json.dump({"metadata": idx["metadata"], "weight_map": weight_map},
+              open(legacy_dir / "model.safetensors.index.json", "w"))
+
+    back = load_hf_weights(vlm, str(legacy_dir))
+    diffs = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(
+            a.astype(jnp.float32) - b.astype(jnp.float32)))), params, back)
+    assert max(jax.tree.leaves(diffs)) == 0.0
+
+
+def test_missing_shard_fails_index_write(model, tmp_path, monkeypatch):
+    """Process 0 must refuse to publish an index naming shard files that are
+    absent from its filesystem (ADVICE r2: non-shared-FS distributed save)."""
+    params = model.init(jax.random.key(3))
+    from safetensors.numpy import save_file as real_save_file
+
+    def dropping_save_file(tensors, path, metadata=None):
+        if "model-00002-" in os.path.basename(path):
+            return  # simulate another host's write landing elsewhere
+        real_save_file(tensors, path, metadata=metadata)
+
+    monkeypatch.setattr("safetensors.numpy.save_file", dropping_save_file)
+    with pytest.raises(RuntimeError, match="distribute_writes=False"):
+        save_hf_weights(model, params, str(tmp_path), max_shard_bytes=200_000)
+
+
 def test_nonconsolidated_save_roundtrips_via_orbax(model, tmp_path):
     from automodel_tpu.checkpoint.checkpointing import (
         CheckpointingConfig,

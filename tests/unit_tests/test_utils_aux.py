@@ -59,3 +59,20 @@ def test_freeze_mask_and_counting():
     assert stats["total"] == 10 * 4 + 16 + 40
     assert stats["trainable"] == 16 + 40
     assert count_parameters(params) == stats["total"]
+
+
+def test_freeze_embeddings_spares_vision_patch_embed():
+    """freeze_embeddings targets token-embedding modules only — a VLM's
+    vision patch/position projections must stay trainable (reference freezes
+    nn.Embedding instances, ``vlm/finetune.py:70-89``)."""
+    params = {
+        "language_model": {"embed_tokens": {"embedding": jnp.ones((10, 4))}},
+        "vision_tower": {
+            "patch_embed": {"kernel": jnp.ones((12, 4))},
+            "pos_embed": {"embedding": jnp.ones((9, 4))},
+        },
+    }
+    mask = make_freeze_mask(params, freeze_embeddings=True)
+    assert mask["language_model"]["embed_tokens"]["embedding"] is False
+    assert mask["vision_tower"]["patch_embed"]["kernel"] is True
+    assert mask["vision_tower"]["pos_embed"]["embedding"] is True
