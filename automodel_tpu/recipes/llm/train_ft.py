@@ -40,7 +40,7 @@ from automodel_tpu.optim import (
 from automodel_tpu.recipes.base_recipe import BaseRecipe
 from automodel_tpu.training.rng import StatefulRNG
 from automodel_tpu.training.step_scheduler import StepScheduler
-from automodel_tpu.training.timers import Timers
+from automodel_tpu.training.timers import Timers, build_profiling_config
 from automodel_tpu.training.train_step import build_train_step, stack_microbatches
 from automodel_tpu.training.utils import count_tokens
 
@@ -368,6 +368,8 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         if self.peft_config is not None:
             self.checkpoint_config.is_peft = True
         self.timers = Timers()
+        self.profiling = build_profiling_config(cfg.get("profiling"))
+        self._tracing = False
         self.wandb = build_wandb(cfg)
         # resume if a checkpoint exists
         self.load_checkpoint()
@@ -494,14 +496,26 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         its own ``step``); ``flush_metrics()`` drains the tail.
         """
         num_tokens, _ = count_tokens(batches)
+        prof = self.profiling
+        self._profile_trace_window()
         self.lr_scheduler.step(1)
         self.opt_state = set_hyperparams(
             self.opt_state, lr=self.lr_scheduler.current_lr,
             wd=self.lr_scheduler.current_wd)
-        batch = self._device_batch(batches)
+        with self.timers.record("data_staging"):
+            batch = self._device_batch(batches)
         t0 = time.perf_counter()
-        self.params, self.opt_state, metrics = self.step_fns.train_step(
-            self.params, self.opt_state, batch)
+        if prof.enabled and prof.barrier:
+            # Measurement mode: block on this step's device results so
+            # step_e2e is true per-step latency (forfeits dispatch overlap).
+            with self.timers.record("step_e2e"):
+                self.params, self.opt_state, metrics = self.step_fns.train_step(
+                    self.params, self.opt_state, batch)
+                jax.block_until_ready(metrics)
+        else:
+            with self.timers.record("dispatch"):
+                self.params, self.opt_state, metrics = self.step_fns.train_step(
+                    self.params, self.opt_state, batch)
         pending = {
             "device_metrics": metrics,
             "step": self.step_scheduler.step,
@@ -554,6 +568,43 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             pass
         return out
 
+    def _profile_trace_window(self):
+        """Windowed ``jax.profiler`` xplane capture: tracing spans optimizer
+        steps ``[trace_start_step, trace_stop_step)`` (the nsys-window
+        equivalent of reference ``timers.py:433-538``-era profiling)."""
+        prof = self.profiling
+        if not (prof.enabled and prof.trace_dir):
+            return
+        step = self.step_scheduler.step
+        if (not self._tracing and prof.trace_start_step <= step
+                < prof.trace_stop_step):
+            jax.profiler.start_trace(prof.trace_dir)
+            self._tracing = True
+        elif self._tracing and step >= prof.trace_stop_step:
+            self.flush_metrics()  # close the window on finished device work
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    def _stop_trace(self):
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    def _timed_iter(self, iterable):
+        """Yield from the step scheduler, timing the data wait (host-side
+        tokenize/collate time the device spends idle)."""
+        it = iter(iterable)
+        while True:
+            t = self.timers("data_wait")
+            t.start()
+            try:
+                batches = next(it)
+            except StopIteration:
+                t.discard()
+                return
+            t.stop()
+            yield batches
+
     def flush_metrics(self) -> Optional[Dict[str, Any]]:
         """Finalize the in-flight step's metrics (end of epoch / before
         checkpointing / end of bench window)."""
@@ -581,10 +632,11 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
     def run_train_validation_loop(self):
         sched = self.step_scheduler
         is_main = self.dist_info.is_main
+        prof = self.profiling
         for epoch in sched.epochs:
             if hasattr(self.dataloader, "set_epoch"):
                 self.dataloader.set_epoch(epoch)
-            for batches in sched:
+            for batches in self._timed_iter(sched):
                 metrics = self._run_train_optim_step(batches)
                 # metrics lag one step; skip steps already emitted
                 if is_main and metrics["step"] != getattr(
@@ -598,6 +650,19 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                         metrics["num_label_tokens"])
                     if self.wandb is not None:
                         self.wandb.log(metrics, step=metrics["step"])
+                if (prof.enabled and sched.step % prof.log_interval == 0):
+                    # per-step ms over the window; host-local, logged on main
+                    elapsed = self.timers.get_elapsed(
+                        reset=True, normalizer=prof.log_interval)
+                    if is_main and elapsed:
+                        logger.info(
+                            "step %d | time (ms)%s", sched.step,
+                            "".join(f" | {n}: {v * 1e3:.2f}"
+                                    for n, v in elapsed.items()))
+                        if self.wandb is not None:
+                            self.wandb.log(
+                                {f"timers/{n}": v for n, v in elapsed.items()},
+                                step=sched.step)
                 if sched.is_val_step:
                     self.flush_metrics()
                     val_loss = self._run_validation_epoch()
@@ -623,6 +688,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 self._last_ckpt_step = sched.step
             if sched.finished:
                 break
+        self._stop_trace()  # loop may end inside an open trace window
         return self
 
 

@@ -11,11 +11,48 @@ via :func:`trace` for xplane-level analysis (the nsys equivalent).
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import time
 from typing import Dict, List, Optional
 
 import jax
 import numpy as np
+
+
+@dataclasses.dataclass
+class ProfilingConfig:
+    """``profiling:`` YAML section — wires :class:`Timers` into the hot loop.
+
+    Reference parity: the recipe-driven timer cadence of
+    ``nemo_automodel/components/training/timers.py:433-538`` plus an nsys-like
+    windowed trace (``jax.profiler`` xplane dump).
+
+    ``barrier=True`` blocks on each step's device results before stopping the
+    ``step_e2e`` timer — true per-step latency, at the cost of the pipelined
+    dispatch overlap (measurement mode, not the training default).
+    """
+
+    enabled: bool = False
+    log_interval: int = 10
+    barrier: bool = False
+    trace_dir: Optional[str] = None
+    trace_start_step: int = 1
+    trace_stop_step: int = 3
+
+
+def build_profiling_config(cfg) -> ProfilingConfig:
+    """ProfilingConfig from a ConfigNode/dict (None -> disabled)."""
+    if cfg is None:
+        return ProfilingConfig()
+    raw = cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg)
+    fields = {f.name for f in dataclasses.fields(ProfilingConfig)}
+    unknown = set(raw) - fields
+    if unknown:
+        raise ValueError(f"unknown profiling keys: {sorted(unknown)}")
+    out = ProfilingConfig(**{k: v for k, v in raw.items()})
+    if "enabled" not in raw:
+        out.enabled = True  # presence of the section turns profiling on
+    return out
 
 
 class _Timer:
@@ -57,6 +94,11 @@ class _Timer:
 
     def mean(self) -> float:
         return float(np.mean(self._history)) if self._history else 0.0
+
+    def discard(self) -> None:
+        """Abandon a running interval without recording it (e.g. a data-wait
+        that ended in StopIteration)."""
+        self._start = None
 
     def reset(self) -> None:
         self._elapsed = 0.0
