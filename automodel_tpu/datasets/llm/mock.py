@@ -79,3 +79,32 @@ def build_packed_dataset(
     return PackedSequence(
         unpacked, packed_sequence_size=packed_sequence_size,
         split_across_pack=split_across_pack).pack()
+
+
+def build_classification_dataset(
+    *,
+    num_examples: int = 64,
+    num_labels: int = 2,
+    mean_len: float = 20.0,
+    std_len: float = 6.0,
+    vocab_size: int = 100,
+    max_sentence_len: int = 64,
+    seed: int = 0,
+    tokenizer=None,
+) -> List[Dict[str, List[int]]]:
+    """Sequence-classification mock: one label per sentence (the reference
+    exercises ``AutoModelForSequenceClassification`` via HF datasets,
+    ``_transformers/auto_model.py:445``).  The label is a deterministic
+    function of the first token (its id modulo ``num_labels``) so a tiny
+    model can actually learn the task in a few steps."""
+    random.seed(seed)
+    vocab = make_vocab(vocab_size)
+    examples = []
+    for _ in range(num_examples):
+        sent = gen_sentence_ids(vocab, mean_len, std_len, max_sentence_len)
+        examples.append({
+            "input_ids": sent,
+            "attention_mask": [1] * len(sent),
+            "labels": sent[0] % num_labels,
+        })
+    return examples

@@ -117,6 +117,19 @@ def default_collater(batch: List[dict],
     return out
 
 
+def classification_collater(batch: List[dict],
+                            pad_seq_len_divisible: Optional[int] = None
+                            ) -> Dict[str, np.ndarray]:
+    """Collater for sequence classification: token keys pad-and-stack like
+    :func:`default_collater`; ``labels`` is one int per EXAMPLE ([B], not
+    [B, S]) — the shape the classification loss and the train step's
+    label-token accounting both expect."""
+    labels = np.asarray([ex.pop("labels") for ex in batch], np.int32)
+    out = default_collater(batch, pad_seq_len_divisible)
+    out["labels"] = labels
+    return out
+
+
 class SFTSingleTurnPreprocessor:
     """Generic single-turn text-to-text SFT preprocessor (reference
     ``datasets/utils.py:150-267``): tokenize context+target, mask the prompt

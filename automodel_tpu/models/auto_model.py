@@ -96,6 +96,52 @@ class AutoModelForImageTextToText:
     from_pretrained = AutoModelForCausalLM.from_pretrained
 
 
+class AutoModelForSequenceClassification:
+    """Classification facade — the reference's third auto-class
+    (``_transformers/auto_model.py:445``): backbone from the registry minus
+    the lm_head, plus a ``score`` head pooled at the last non-pad token
+    (``models/sequence_classification.py``)."""
+
+    @staticmethod
+    def from_config(config: Any, num_labels: Optional[int] = None,
+                    pad_token_id: Optional[int] = None, **model_kwargs) -> Any:
+        from automodel_tpu.models.sequence_classification import (
+            ForSequenceClassification,
+        )
+
+        if isinstance(config, dict):
+            if num_labels is None:
+                n = config.get("num_labels") or len(config.get("id2label") or ())
+                num_labels = int(n) if n else 2
+            if pad_token_id is None:
+                pad_token_id = config.get("pad_token_id")
+        backbone = AutoModelForCausalLM.from_config(config, **model_kwargs)
+        return ForSequenceClassification(
+            backbone, num_labels=num_labels or 2, pad_token_id=pad_token_id)
+
+    @staticmethod
+    def from_pretrained(
+        pretrained_model_name_or_path: str,
+        load_weights: bool = False,
+        num_labels: Optional[int] = None,
+        **model_kwargs,
+    ) -> Any:
+        ckpt_dir = resolve_checkpoint_dir(pretrained_model_name_or_path)
+        if ckpt_dir is None:
+            raise FileNotFoundError(
+                f"Cannot resolve {pretrained_model_name_or_path!r} to a local "
+                "checkpoint directory (no network egress; pre-populate the HF "
+                "cache or pass a local path)")
+        with open(os.path.join(ckpt_dir, "config.json")) as f:
+            hf_cfg = json.load(f)
+        model = AutoModelForSequenceClassification.from_config(
+            hf_cfg, num_labels=num_labels, **model_kwargs)
+        model.checkpoint_dir = ckpt_dir
+        if load_weights:
+            model.params = load_hf_weights(model, ckpt_dir)
+        return model
+
+
 def build_model(name_or_path: Optional[str] = None, config: Optional[dict] = None,
                 **kwargs) -> Any:
     """YAML-friendly builder: from checkpoint path or inline config dict."""
@@ -106,3 +152,17 @@ def build_model(name_or_path: Optional[str] = None, config: Optional[dict] = Non
             config = config.to_dict()
         return AutoModelForCausalLM.from_config(config, **kwargs)
     raise ValueError("build_model needs name_or_path or config")
+
+
+def build_sequence_classifier(name_or_path: Optional[str] = None,
+                              config: Optional[dict] = None,
+                              **kwargs) -> Any:
+    """YAML-friendly classification builder (mirrors :func:`build_model`)."""
+    if name_or_path is not None:
+        return AutoModelForSequenceClassification.from_pretrained(
+            name_or_path, **kwargs)
+    if config is not None:
+        if hasattr(config, "to_dict"):
+            config = config.to_dict()
+        return AutoModelForSequenceClassification.from_config(config, **kwargs)
+    raise ValueError("build_sequence_classifier needs name_or_path or config")

@@ -53,13 +53,19 @@ class HfSpec:
                  transpose: bool = False,
                  expert_stacked: bool = False,
                  load_transform: Optional[Callable] = None,
-                 save_transform: Optional[Callable] = None):
+                 save_transform: Optional[Callable] = None,
+                 missing_init: Optional[Callable] = None):
         self.template = template
         self.stacked = stacked
         self.expert_stacked = expert_stacked
         self.transpose = transpose
         self.load_transform = load_transform
         self.save_transform = save_transform
+        # (shape, dtype) -> np.ndarray used when the checkpoint lacks the
+        # tensor: heads a base checkpoint does not carry (e.g. ``score.weight``
+        # when fine-tuning a classifier from a causal-LM base — HF
+        # random-inits missing heads the same way).
+        self.missing_init = missing_init
 
 
 def llama_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
@@ -242,6 +248,11 @@ def gemma3_vlm_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
 def _key_map_for(model) -> Dict[Tuple[str, ...], HfSpec]:
     from automodel_tpu.models.registry import get_family
 
+    if hasattr(model, "hf_key_map"):
+        # wrapper models (e.g. sequence classification re-rooting a backbone)
+        # own their mapping; the registry is keyed by model_type, which a
+        # wrapper shares with its base family
+        return model.hf_key_map()
     return get_family(model.config.model_type).key_map_fn(model.config)
 
 
@@ -369,6 +380,9 @@ def load_hf_weights(
             sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
 
         def cb(idx: Tuple[slice, ...], spec=spec, shape=shape, dtype=dtype):
+            if (spec.missing_init is not None and not spec.stacked
+                    and spec.template not in ckpt):
+                return np.asarray(spec.missing_init(shape, dtype))[idx]
             if spec.expert_stacked:
                 l0, l1, _ = idx[0].indices(shape[0])
                 e0, e1, _ = idx[1].indices(shape[1])
@@ -575,7 +589,10 @@ def save_hf_config(model, out_dir: str) -> None:
 
     cfg = model.config
     d = dataclasses.asdict(cfg)
-    d["architectures"] = get_family(cfg.model_type).hf_architectures
+    d["architectures"] = (getattr(model, "hf_architectures", None)
+                          or get_family(cfg.model_type).hf_architectures)
+    for k, v in getattr(model, "hf_config_extra", lambda: {})().items():
+        d[k] = v
     with open(os.path.join(out_dir, "config.json"), "w") as f:
         json.dump(d, f, indent=2, default=str)
 

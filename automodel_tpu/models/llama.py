@@ -461,10 +461,13 @@ class LlamaForCausalLM:
         lm_kernel = (
             params["embed_tokens"]["embedding"].T
             if cfg.tie_word_embeddings
-            else params["lm_head"]["kernel"]
+            # headless backbones (sequence classification) have no lm_head
+            else params.get("lm_head", {}).get("kernel")
         )
         if return_hidden:
-            out = {"hidden_states": hidden, "lm_head_kernel": lm_kernel}
+            out = {"hidden_states": hidden}
+            if lm_kernel is not None:
+                out["lm_head_kernel"] = lm_kernel
         else:
             logits = hidden @ lm_kernel.astype(self.compute_dtype)
             out = {"logits": constrain(

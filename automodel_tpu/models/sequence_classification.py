@@ -1,0 +1,149 @@
+"""Sequence classification on top of any causal-LM backbone.
+
+Reference parity: ``NeMoAutoModelForSequenceClassification``
+(``nemo_automodel/components/_transformers/auto_model.py:445-``) — HF's
+``*ForSequenceClassification`` family: the decoder backbone without its
+``lm_head``, plus a bias-free ``score`` head, pooling the hidden state of
+the **last non-pad token** of each sequence (the HF causal-LM convention).
+
+Re-rooted the framework way: the wrapper owns a registry-built backbone
+(Llama/Qwen/Mistral/Gemma — anything whose forward supports
+``return_hidden``), params live under ``{"backbone": ..., "score": ...}``,
+and the HF key map re-roots the backbone map so published
+``LlamaForSequenceClassification`` checkpoints round-trip bit-exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ForSequenceClassification:
+    """Functional wrapper: ``init`` / ``__call__`` / ``param_axes`` mirror the
+    backbone contract, so plans, train steps and checkpointing all compose."""
+
+    def __init__(self, backbone, num_labels: int,
+                 pad_token_id: Optional[int] = None):
+        self.backbone = backbone
+        self.config = backbone.config
+        self.num_labels = int(num_labels)
+        self.pad_token_id = pad_token_id
+        self.compute_dtype = backbone.compute_dtype
+        self.param_dtype = backbone.param_dtype
+
+    # -- params ------------------------------------------------------------
+    def _headless(self, tree: Dict[str, Any]) -> Dict[str, Any]:
+        tree = dict(tree)
+        tree.pop("lm_head", None)   # HF seq-cls checkpoints carry no lm_head
+        return tree
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        k_base, k_score = jax.random.split(key)
+        score = (jax.random.normal(
+            k_score, (self.config.hidden_size, self.num_labels), jnp.float32)
+            * 0.02).astype(self.param_dtype)
+        return {
+            "backbone": self._headless(self.backbone.init(k_base)),
+            "score": {"kernel": score},
+        }
+
+    def abstract_params(self) -> Dict[str, Any]:
+        return jax.eval_shape(self.init, jax.random.key(0))
+
+    def param_axes(self) -> Dict[str, Any]:
+        return {
+            "backbone": self._headless(self.backbone.param_axes()),
+            # num_labels is tiny: keep the output dim replicated
+            "score": {"kernel": ("embed", None)},
+        }
+
+    # -- forward -----------------------------------------------------------
+    def _last_token_index(self, input_ids, attention_mask):
+        B, S = input_ids.shape
+        if attention_mask is not None:
+            return jnp.sum(attention_mask.astype(jnp.int32), axis=-1) - 1
+        if self.pad_token_id is not None:
+            # first pad position - 1, wrapped to S-1 when there is no pad
+            # (transformers' modulo trick in LlamaForSequenceClassification)
+            is_pad = (input_ids == self.pad_token_id).astype(jnp.int32)
+            first_pad = jnp.argmax(is_pad, axis=-1)
+            has_pad = jnp.any(is_pad.astype(bool), axis=-1)
+            return jnp.where(has_pad, first_pad - 1, S - 1) % S
+        return jnp.full((B,), S - 1, jnp.int32)
+
+    def __call__(
+        self,
+        params: Dict[str, Any],
+        input_ids: jnp.ndarray,                    # [B, S]
+        position_ids: Optional[jnp.ndarray] = None,
+        segment_ids: Optional[jnp.ndarray] = None,
+        attention_mask: Optional[jnp.ndarray] = None,
+        **kwargs,
+    ) -> Dict[str, jnp.ndarray]:
+        if kwargs.pop("return_hidden", False):
+            raise ValueError(
+                "sequence classification has no lm_head: fused-linear-CE "
+                "losses (needs_hidden=True) are incompatible — configure "
+                "loss_fn: MaskedCrossEntropy")
+        out = self.backbone(
+            params["backbone"], input_ids, position_ids=position_ids,
+            segment_ids=segment_ids, attention_mask=attention_mask,
+            return_hidden=True, **kwargs)
+        hidden = out["hidden_states"]              # [B, S, H]
+        idx = self._last_token_index(input_ids, attention_mask)
+        pooled = jnp.take_along_axis(
+            hidden, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        logits = pooled @ params["score"]["kernel"].astype(self.compute_dtype)
+        result = {"logits": logits}                # [B, num_labels]
+        if "aux_loss" in out:
+            result["aux_loss"] = out["aux_loss"]
+        return result
+
+    # -- HF io -------------------------------------------------------------
+    @property
+    def hf_architectures(self):
+        base = type(self.backbone).__name__.replace("ForCausalLM", "")
+        return [f"{base}ForSequenceClassification"]
+
+    def hf_config_extra(self) -> Dict[str, Any]:
+        return {
+            "num_labels": self.num_labels,
+            "pad_token_id": self.pad_token_id,
+            "id2label": {str(i): f"LABEL_{i}" for i in range(self.num_labels)},
+            "label2id": {f"LABEL_{i}": i for i in range(self.num_labels)},
+        }
+
+    def hf_key_map(self):
+        from automodel_tpu.models.hf_io import HfSpec
+        from automodel_tpu.models.registry import get_family
+
+        base = get_family(self.config.model_type).key_map_fn(self.config)
+        m = {("backbone",) + path: spec for path, spec in base.items()
+             if path[0] != "lm_head"}
+
+        def fresh_head(shape, dtype):
+            # base causal-LM checkpoints carry no score head: random-init it
+            # (HF from_pretrained does the same for a new classification head)
+            k = jax.random.key(0)
+            return np.asarray(
+                jax.random.normal(k, shape, jnp.float32) * 0.02, dtype)
+
+        m[("score", "kernel")] = HfSpec("score.weight", transpose=True,
+                                        missing_init=fresh_head)
+        return m
+
+    # -- misc contract ------------------------------------------------------
+    @property
+    def checkpoint_dir(self):
+        return getattr(self.backbone, "checkpoint_dir", None)
+
+    @checkpoint_dir.setter
+    def checkpoint_dir(self, v):
+        self.backbone.checkpoint_dir = v
+
+    def flops_per_token(self) -> float:
+        return self.backbone.flops_per_token()

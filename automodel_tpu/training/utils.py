@@ -32,6 +32,14 @@ def count_tokens(batch, ignore_label: int = IGNORE_INDEX):
         totals = [count_tokens(b, ignore_label) for b in batch]
         return sum(t[0] for t in totals), sum(t[1] for t in totals)
     labels = np.asarray(batch["labels"])
+    if labels.ndim == 1 and "input_ids" in batch:
+        # sequence classification: one label per EXAMPLE — tokens processed
+        # come from the input stream, not the label tensor (labels.size here
+        # is the batch size, which would report examples/sec as tps)
+        mask = batch.get("attention_mask")
+        num_tokens = (int(np.asarray(mask).sum()) if mask is not None
+                      else int(np.asarray(batch["input_ids"]).size))
+        return num_tokens, int((labels != ignore_label).sum())
     num_tokens = labels.size - count_tail_padding(labels, ignore_label)
     num_label_tokens = int((labels != ignore_label).sum())
     return num_tokens, num_label_tokens

@@ -1,0 +1,123 @@
+"""AutoModelForSequenceClassification: HF parity + training.
+
+Reference: the third auto-class, ``nemo_automodel/components/_transformers/
+auto_model.py:445`` (HF ``LlamaForSequenceClassification`` semantics: no
+lm_head, bias-free ``score`` head, pooling at the last non-pad token).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from automodel_tpu.models.auto_model import AutoModelForSequenceClassification
+
+TINY = dict(
+    model_type="llama", vocab_size=128, hidden_size=64,
+    intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
+    num_key_value_heads=2, rope_theta=10000.0, tie_word_embeddings=False,
+    max_position_embeddings=64, pad_token_id=0, num_labels=3)
+
+
+def _model():
+    return AutoModelForSequenceClassification.from_config(
+        dict(TINY), param_dtype=jnp.float32, compute_dtype=jnp.float32,
+        remat=False)
+
+
+def _randomized(model, key):
+    params = model.init(key)
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(jax.random.fold_in(key, 7), len(leaves))
+    leaves = [
+        (l + 0.02 * jax.random.normal(k, l.shape, jnp.float32)).astype(l.dtype)
+        for l, k in zip(leaves, keys)
+    ]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def test_logits_match_transformers_with_padding(tmp_path):
+    from automodel_tpu.models.hf_io import save_hf_weights
+
+    model = _model()
+    params = _randomized(model, jax.random.key(0))
+    save_hf_weights(model, params, str(tmp_path))
+    hf = transformers.AutoModelForSequenceClassification.from_pretrained(
+        str(tmp_path), torch_dtype=torch.float32,
+        attn_implementation="eager")
+    hf.eval()
+    assert hf.config.num_labels == 3
+
+    rng = np.random.default_rng(0)
+    B, S = 3, 12
+    ids = rng.integers(1, 128, (B, S)).astype(np.int64)
+    ids[0, 8:] = 0    # right padding -> pooling picks position 7
+    ids[2, 5:] = 0
+    with torch.no_grad():
+        ref = hf(input_ids=torch.from_numpy(ids)).logits.numpy()
+    ours = model(params, jnp.asarray(ids, jnp.int32))["logits"]
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=2e-4, rtol=2e-3)
+
+
+def test_hf_roundtrip_bitwise(tmp_path):
+    from automodel_tpu.models.hf_io import load_hf_weights, save_hf_weights
+
+    model = _model()
+    params = _randomized(model, jax.random.key(1))
+    save_hf_weights(model, params, str(tmp_path))
+    back = load_hf_weights(model, str(tmp_path))
+    diffs = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), params, back)
+    assert max(jax.tree.leaves(diffs)) == 0.0
+
+
+def test_load_from_base_causal_checkpoint(tmp_path):
+    """Fine-tuning a classifier from a plain causal-LM checkpoint: the
+    backbone loads from the checkpoint; the absent ``score.weight`` head is
+    random-initialized (HF from_pretrained behavior for new heads)."""
+    from automodel_tpu.models.auto_model import AutoModelForCausalLM
+    from automodel_tpu.models.hf_io import save_hf_weights
+
+    base_cfg = {k: v for k, v in TINY.items()
+                if k not in ("num_labels", "pad_token_id")}
+    base = AutoModelForCausalLM.from_config(
+        dict(base_cfg), param_dtype=jnp.float32, compute_dtype=jnp.float32,
+        remat=False)
+    base_params = _randomized(base, jax.random.key(2))
+    save_hf_weights(base, base_params, str(tmp_path))
+
+    model = AutoModelForSequenceClassification.from_pretrained(
+        str(tmp_path), load_weights=True, num_labels=3, pad_token_id=0,
+        param_dtype=jnp.float32, compute_dtype=jnp.float32, remat=False)
+    loaded = model.params
+    diffs = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))),
+        loaded["backbone"], model._headless(base_params))
+    assert max(jax.tree.leaves(diffs)) == 0.0
+    score = np.asarray(loaded["score"]["kernel"])
+    assert score.shape == (64, 3)
+    assert np.std(score) > 0  # fresh head, not zeros
+
+
+def test_classification_recipe_learns(tmp_path):
+    """The finetune recipe end-to-end on the classification YAML: loss
+    descends below chance on the deterministic first-token task."""
+    import os
+
+    from automodel_tpu.config.arg_parser import parse_args_and_load_config
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
+    )
+
+    yaml = os.path.join(os.path.dirname(__file__), "..", "..", "examples",
+                        "llm_finetune", "tiny_llama_seqcls_mock.yaml")
+    cfg = parse_args_and_load_config(["--config", yaml])
+    recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+    first = recipe._run_train_optim_step(next(iter(recipe.step_scheduler)))
+    recipe.run_train_validation_loop()
+    assert recipe.step_scheduler.step == 8
+    assert np.isfinite(recipe.last_metrics["loss"])
+    assert recipe.last_metrics["loss"] < first["loss"]
