@@ -7,8 +7,15 @@ Reference parity: ``nemo_automodel/components/datasets/vlm/collate_fns.py:
 TPU-native contract (what ``training/train_step.py`` consumes):
   * ``input_ids``  [B, S] int32, image placeholders already expanded so each
     image contributes exactly ``n_patches`` tokens of ``image_token_id``.
-  * ``pixel_values`` [B_img, H, W, C] float32 (NHWC — HF processors emit
-    NCHW, converted here; ``VisionTower.patchify`` is NHWC).
+  * ``pixel_values`` [B, I, H, W, C] float32 — per-ROW image slots (NHWC;
+    HF processors emit flat NCHW, converted and re-rowed here).  Row i's
+    images sit in slots [0, count_i); trailing slots are zero and are never
+    gathered (each row's placeholder count matches its real images).  The
+    per-row layout is what lets the batch dim shard over dp and the per-host
+    input pipeline assemble images without cross-host coordination (the
+    flat layout's global row-major cumsum could not).
+  * ``pad_seq_len_divisible``: right-pads the text fields so S hits the
+    128-multiple the splash kernel needs (val bucketing / fast path).
   * ``labels`` [B, S] int32: next-token shift of ``input_ids`` with -100 on
     the final position, on pad/image/special tokens, and on everything
     before the start-of-response marker.  The loss mask is folded into the
@@ -114,13 +121,65 @@ def _gather_images(examples: List[dict]) -> Optional[List[Any]]:
     return out if found else None
 
 
+def _row_image_slots(flat: np.ndarray, counts: List[int],
+                     max_images_per_example: Optional[int] = None
+                     ) -> np.ndarray:
+    """Flat [sum(counts), H, W, C] (processor emission order) -> per-row
+    slots [B, I, H, W, C], trailing slots zero."""
+    n_rows = len(counts)
+    if sum(counts) != flat.shape[0]:
+        raise ValueError(
+            f"processor emitted {flat.shape[0]} images but examples carry "
+            f"{sum(counts)} — image order cannot be trusted for per-row "
+            "slotting")
+    i_max = max(max(counts), 1)
+    if max_images_per_example is not None:
+        if max(counts) > max_images_per_example:
+            raise ValueError(
+                f"an example carries {max(counts)} images > "
+                f"max_images_per_example={max_images_per_example}")
+        i_max = max_images_per_example
+    out = np.zeros((n_rows, i_max) + flat.shape[1:], flat.dtype)
+    pos = 0
+    for r, c in enumerate(counts):
+        out[r, :c] = flat[pos:pos + c]
+        pos += c
+    return out
+
+
+def _pad_text_fields(out: Dict[str, np.ndarray], processor,
+                     divisible: int) -> None:
+    s = out["input_ids"].shape[1]
+    pad = (-s) % divisible
+    if not pad:
+        return
+    tokenizer = getattr(processor, "tokenizer", processor)
+    pad_id = getattr(tokenizer, "pad_token_id", None) or 0
+    out["input_ids"] = np.pad(out["input_ids"], ((0, 0), (0, pad)),
+                              constant_values=pad_id)
+    out["labels"] = np.pad(out["labels"], ((0, 0), (0, pad)),
+                           constant_values=CROSS_ENTROPY_IGNORE_IDX)
+    out["loss_mask"] = np.pad(out["loss_mask"], ((0, 0), (0, pad)))
+
+
 def _collate(examples: List[dict], processor,
              start_of_response_token: Optional[str],
-             max_length: Optional[int] = None) -> Dict[str, np.ndarray]:
+             max_length: Optional[int] = None,
+             pad_seq_len_divisible: Optional[int] = None,
+             max_images_per_example: Optional[int] = None,
+             fixed_length: Optional[int] = None
+             ) -> Dict[str, np.ndarray]:
+    """``fixed_length``: pad/truncate every batch to exactly this S — the
+    knob a per-host input pipeline needs (hosts collate disjoint row subsets,
+    so batch-max padding would give each host a different S and the global
+    array could not be assembled)."""
     texts = [processor.apply_chat_template(ex["conversation"], tokenize=False)
              for ex in examples]
     kwargs: Dict[str, Any] = dict(padding=True, return_tensors="np")
-    if max_length is not None:
+    if fixed_length is not None:
+        kwargs.update(padding="max_length", truncation=True,
+                      max_length=int(fixed_length))
+    elif max_length is not None:
         kwargs.update(truncation=True, max_length=max_length)
     images = _gather_images(examples)
     if images is not None:
@@ -130,7 +189,9 @@ def _collate(examples: List[dict], processor,
     out: Dict[str, np.ndarray] = {
         "input_ids": _as_numpy(batch["input_ids"]).astype(np.int32)}
     if batch.get("pixel_values") is not None:
-        out["pixel_values"] = to_nhwc(batch["pixel_values"])
+        counts = [len(imgs) for imgs in (images or [])]
+        out["pixel_values"] = _row_image_slots(
+            to_nhwc(batch["pixel_values"]), counts, max_images_per_example)
 
     loss_masks = [
         create_loss_mask_with_start_of_response_token(
@@ -141,19 +202,29 @@ def _collate(examples: List[dict], processor,
     out["labels"] = _shifted_masked_labels(
         out["input_ids"], skipped, loss_masks)
     out["loss_mask"] = np.asarray(loss_masks, np.float32)
+    if pad_seq_len_divisible:
+        _pad_text_fields(out, processor, int(pad_seq_len_divisible))
     return out
 
 
 def qwen2_5_collate_fn(examples: List[dict], processor,
-                       start_of_response_token: str = "<|im_start|>assistant\n"
+                       start_of_response_token: str = "<|im_start|>assistant\n",
+                       pad_seq_len_divisible: Optional[int] = None,
+                       max_images_per_example: Optional[int] = None,
+                       fixed_length: Optional[int] = None
                        ) -> Dict[str, np.ndarray]:
     """Qwen2.5-VL: im_start/assistant response marker (reference
     ``collate_fns.py:120-148``)."""
-    return _collate(examples, processor, start_of_response_token)
+    return _collate(examples, processor, start_of_response_token,
+                    pad_seq_len_divisible=pad_seq_len_divisible,
+                    max_images_per_example=max_images_per_example,
+                    fixed_length=fixed_length)
 
 
 def phi4_mm_collate_fn(examples: List[dict], processor,
-                       max_length: int = 1024) -> Dict[str, np.ndarray]:
+                       max_length: int = 1024,
+                       pad_seq_len_divisible: Optional[int] = None
+                       ) -> Dict[str, np.ndarray]:
     """Phi-4-multimodal audio path (reference ``collate_fns.py:77-117``):
     the supervised span is located by matching the assistant turn's own
     token ids inside ``input_ids`` (no chat-template response marker), and
@@ -207,14 +278,22 @@ def phi4_mm_collate_fn(examples: List[dict], processor,
     out["labels"] = _shifted_masked_labels(
         input_ids, extract_skipped_token_ids(processor), loss_masks)
     out["loss_mask"] = np.asarray(loss_masks, np.float32)
+    if pad_seq_len_divisible:
+        _pad_text_fields(out, processor, int(pad_seq_len_divisible))
     return out
 
 
 def default_collate_fn(examples: List[dict], processor,
-                       start_of_response_token: Optional[str] = None
+                       start_of_response_token: Optional[str] = None,
+                       pad_seq_len_divisible: Optional[int] = None,
+                       max_images_per_example: Optional[int] = None,
+                       fixed_length: Optional[int] = None
                        ) -> Dict[str, np.ndarray]:
     """Gemma3-style default path (reference ``collate_fns.py:151-184``)."""
-    return _collate(examples, processor, start_of_response_token)
+    return _collate(examples, processor, start_of_response_token,
+                    pad_seq_len_divisible=pad_seq_len_divisible,
+                    max_images_per_example=max_images_per_example,
+                    fixed_length=fixed_length)
 
 
 # Processor class name -> collate fn (reference ``collate_fns.py:187-190``).

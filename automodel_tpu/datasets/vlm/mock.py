@@ -109,6 +109,8 @@ class MockVLMProcessor:
         if truncation and max_length:
             seqs = [s[:max_length] for s in seqs]
         width = max(len(s) for s in seqs)
+        if padding == "max_length" and max_length:  # HF fixed-length contract
+            width = max_length
         pad = self.tokenizer.pad_token_id
         batch: Dict[str, np.ndarray] = {
             "input_ids": np.asarray(

@@ -447,15 +447,14 @@ class Gemma3ForConditionalGeneration:
             float(cfg.text_config.hidden_size) ** 0.5, self.compute_dtype)
 
         if pixel_values is not None:
-            img = self.encode_images(params, pixel_values)
-            img_flat = img.reshape(-1, img.shape[-1])
-            is_img = (input_ids == cfg.image_token_index).reshape(-1)
-            idx = jnp.clip(jnp.cumsum(is_img) - 1, 0, img_flat.shape[0] - 1)
-            gathered = img_flat[idx].reshape(B, S, -1)
             # HF order: scale token embeds, then overwrite image positions
             # with the (unscaled) projected image features
-            embeds = jnp.where(is_img.reshape(B, S)[..., None], gathered,
-                               embeds)
+            from automodel_tpu.models.vlm import merge_image_embeds
+
+            embeds = merge_image_embeds(
+                embeds, input_ids, pixel_values,
+                lambda pv: self.encode_images(params, pv),
+                cfg.image_token_index)
 
         return lm.forward_embeds(
             lp, embeds, position_ids=position_ids, segment_ids=segment_ids,

@@ -22,6 +22,34 @@ from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from automodel_tpu.models.vision import VisionConfig, VisionTower
 
 
+def merge_image_embeds(embeds, input_ids, pixel_values, encode, token_id):
+    """Scatter image features into placeholder token positions.
+
+    ``pixel_values`` [B, I, H, W, C] (per-row image slots, the collator
+    contract): each row's j-th placeholder run receives its OWN j-th image's
+    patches — a per-row cumsum, so the batch dim stays dp-shardable and the
+    per-host input pipeline needs no cross-host image coordination.  The
+    legacy flat [B_img, H, W, C] layout (generation examples, hand-built
+    batches) keeps the global row-major scatter; it is only valid unsharded.
+    """
+    B, S = input_ids.shape
+    is_img = input_ids == token_id
+    if pixel_values.ndim == 5:
+        I = pixel_values.shape[1]
+        img = encode(pixel_values.reshape((B * I,) + pixel_values.shape[2:]))
+        img_rows = img.reshape(B, I * img.shape[1], -1)    # [B, I*P, Ht]
+        idx = jnp.cumsum(is_img, axis=-1) - 1              # per-row
+        idx = jnp.clip(idx, 0, img_rows.shape[1] - 1)
+        gathered = jnp.take_along_axis(img_rows, idx[..., None], axis=1)
+    else:
+        img = encode(pixel_values)                         # [Bi, P, Ht]
+        img_flat = img.reshape(-1, img.shape[-1])
+        idx = jnp.clip(jnp.cumsum(is_img.reshape(-1)) - 1, 0,
+                       img_flat.shape[0] - 1)
+        gathered = img_flat[idx].reshape(B, S, -1)
+    return jnp.where(is_img[..., None], gathered, embeds)
+
+
 @dataclasses.dataclass
 class VLMConfig:
     text_config: LlamaConfig = None
@@ -130,17 +158,10 @@ class VLMForConditionalGeneration:
             self.compute_dtype)
 
         if pixel_values is not None:
-            img = self.encode_images(params, pixel_values)   # [Bi, P, Ht]
-            img_flat = img.reshape(-1, img.shape[-1])        # [Bi*P, Ht]
-            # scatter image embeds into placeholder positions row-major:
-            # the j-th placeholder token overall receives the j-th image
-            # feature (collators emit exactly n_patches placeholders/image)
-            is_img = (input_ids == self.config.image_token_id).reshape(-1)
-            idx = jnp.cumsum(is_img) - 1                     # [B*S]
-            idx = jnp.clip(idx, 0, img_flat.shape[0] - 1)
-            gathered = img_flat[idx].reshape(B, S, -1)
-            embeds = jnp.where(
-                is_img.reshape(B, S)[..., None], gathered, embeds)
+            embeds = merge_image_embeds(
+                embeds, input_ids, pixel_values,
+                lambda pv: self.encode_images(params, pv),
+                self.config.image_token_id)
 
         return lm.forward_embeds(
             lp, embeds,

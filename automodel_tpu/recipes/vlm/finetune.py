@@ -58,21 +58,44 @@ def select_collate_fn(dl_cfg: Optional[ConfigNode], processor) -> Callable:
     otherwise dispatch on the processor class name through ``COLLATE_FNS``
     (reference ``vlm/finetune.py`` collate wiring +
     ``datasets/vlm/collate_fns.py:187-190``)."""
+    from automodel_tpu.recipes.llm.train_ft import _accepts_kwarg
+
+    def bind(fn, call):
+        """Forward loader kwargs (pad_seq_len_divisible, ...) only when the
+        collator's signature takes them — custom collators stay simple."""
+        def collate(examples, **kw):
+            kw = {k: v for k, v in kw.items() if _accepts_kwarg(fn, k)}
+            return call(examples, kw)
+        return collate
+
     node = dl_cfg.get("collate_fn") if isinstance(dl_cfg, ConfigNode) else None
     if isinstance(node, ConfigNode) and "_target_" in node:
-        return lambda examples: node.instantiate(
-            examples=examples, processor=processor)
+        from automodel_tpu.config.loader import resolve_target
+
+        target = resolve_target(node.get("_target_"))
+        return bind(target, lambda examples, kw: node.instantiate(
+            examples=examples, processor=processor, **kw))
     if callable(node):
-        return functools.partial(node, processor=processor)
+        return bind(node, lambda examples, kw: node(
+            examples, processor=processor, **kw))
     name = type(processor).__name__
     if name not in COLLATE_FNS:
         logger.warning("No dedicated collate_fn for %s; using default", name)
         name = "default"
-    return functools.partial(COLLATE_FNS[name], processor=processor)
+    fn = COLLATE_FNS[name]
+    extra: Dict[str, Any] = {}
+    # shape-pinning knobs a per-host input pipeline needs (hosts collate
+    # disjoint row subsets and must agree on [B, S] / [B, I, ...] shapes)
+    for knob in ("max_images_per_example", "fixed_length"):
+        v = dl_cfg.get(knob) if isinstance(dl_cfg, ConfigNode) else None
+        if v is not None and _accepts_kwarg(fn, knob):
+            extra[knob] = int(v)
+    return functools.partial(fn, processor=processor, **extra)
 
 
 def build_vlm_dataloader(cfg: ConfigNode, dataset, processor,
-                         cfg_key: str, batch_size: int, seed: int):
+                         cfg_key: str, batch_size: int, seed: int,
+                         host_rows=None):
     dl_cfg = cfg.get(cfg_key)
     kwargs: Dict[str, Any] = {}
     if isinstance(dl_cfg, ConfigNode):
@@ -80,6 +103,8 @@ def build_vlm_dataloader(cfg: ConfigNode, dataset, processor,
                   if k not in ("_target_", "collate_fn")}
     kwargs.setdefault("batch_size", batch_size)
     kwargs.setdefault("seed", seed)
+    if host_rows is not None:
+        kwargs.setdefault("host_rows", host_rows)
     cls = StatefulDataLoader
     target = dl_cfg.get("_target_") if isinstance(dl_cfg, ConfigNode) else None
     if target:
@@ -111,16 +136,44 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
             self.model.abstract_params(), freeze_cfg)
 
     def _setup_data(self, global_mb: int) -> None:
+        import jax
+
         cfg = self.cfg
         self.processor = build_processor(cfg, self.model)
         self.tokenizer = getattr(self.processor, "tokenizer", None)
         dataset = build_dataset(cfg.get("dataset"))
+        # Per-host input sharding (reference: per-rank sampler,
+        # ``vlm/finetune.py:612-641``): each host processes/collates only its
+        # own dp rows — image tensors compose because the collators emit
+        # per-row image slots ([B, I, H, W, C]).  Hosts must agree on shapes:
+        # set dataloader.max_images_per_example for multi-image data.
+        self._host_rows = None
+        if jax.process_count() > 1:
+            from automodel_tpu.distributed.shardings import process_batch_rows
+
+            self._host_rows = process_batch_rows(
+                self.mesh_manager.mesh, global_mb)
+            if cfg.get("dataloader.fixed_length") is None:
+                logger.warning(
+                    "per-host VLM input sharding with batch-max padding: "
+                    "hosts collate disjoint rows, so their padded S can "
+                    "disagree and the global batch cannot be assembled — "
+                    "set dataloader.fixed_length (and, for multi-image "
+                    "data, dataloader.max_images_per_example)")
+        # Splash fast path + val shape bucketing: pad text to 128 multiples
+        # (mirrors the LLM recipe's unpacked default; every distinct [B, S]
+        # recompiles eval_step otherwise)
+        for key in ("dataloader", "validation_dataloader"):
+            if f"{key}.pad_seq_len_divisible" not in cfg:
+                cfg.set_by_dotted(f"{key}.pad_seq_len_divisible", 128)
         self.dataloader = build_vlm_dataloader(
             cfg, dataset, self.processor, "dataloader",
-            batch_size=global_mb, seed=self.rng.seed)
+            batch_size=global_mb, seed=self.rng.seed,
+            host_rows=self._host_rows)
         self.val_dataloader = None
         if cfg.get("validation_dataset") is not None:
             val_ds = build_dataset(cfg.get("validation_dataset"))
+            # validation stays on the global loader (see the LLM recipe)
             self.val_dataloader = build_vlm_dataloader(
                 cfg, val_ds, self.processor, "validation_dataloader",
                 batch_size=global_mb, seed=self.rng.seed)

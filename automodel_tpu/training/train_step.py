@@ -104,11 +104,13 @@ class TrainStepFns:
                     process_local: bool = False) -> Dict[str, Any]:
         """Place a stacked microbatch dict on the mesh with per-key specs:
         [A, B, S] token arrays get the dp x cp batch sharding; pixel_values
-        [A, B_img, H, W, C] shard the image-batch dim over dp only (images
-        have no sequence dim to context-parallelize); anything else is
+        [A, B, I, H, W, C] (per-row image slots, the collator contract)
+        shard the batch dim over dp only (images have no sequence dim to
+        context-parallelize); legacy flat [A, B_img, H, W, C] image stacks
+        shard when the dp split divides, else replicate; anything else is
         replicated.
 
-        ``process_local``: [A, B_local, S] arrays hold only THIS host's dp
+        ``process_local``: [A, B_local, ...] arrays hold only THIS host's dp
         rows (per-host input pipeline) — assembled into global arrays via
         ``make_array_from_process_local_data`` instead of ``device_put``.
         Replicated leaves must be host-invariant either way."""
@@ -116,7 +118,6 @@ class TrainStepFns:
             return stacked
         mesh = self.microbatch_sharding.mesh
         spec = self.microbatch_sharding.spec  # P(None, dp_axes, cp_axes)
-        pixel_sharding = NamedSharding(mesh, P(*spec[:2]))
         rep = NamedSharding(mesh, P())
 
         def axis_size(spec_entry) -> int:
@@ -129,19 +130,39 @@ class TrainStepFns:
 
         def place(key, v):
             if key == "pixel_values":
-                # Image counts are data-dependent (multi-image conversations);
-                # fall back to replication when the dp split doesn't divide.
+                ndim = getattr(v, "ndim", 0)
+                if ndim == 6:
+                    # [A, B, I, H, W, C]: rows shard exactly like the token
+                    # batch dim — this is what makes per-host VLM input work
+                    sh = NamedSharding(mesh, P(*spec[:2]))
+                    if process_local:
+                        return jax.make_array_from_process_local_data(
+                            sh, np.asarray(v))
+                    return jax.device_put(v, sh)
+                # legacy flat image stack: counts are data-dependent; shard
+                # when the dp split divides, else replicate
                 assert not process_local, (
-                    "per-host input sharding does not support pixel_values; "
-                    "use the global loader for VLM runs")
+                    "per-host input sharding needs the per-row image-slot "
+                    "layout ([A, B, I, H, W, C]); flat pixel_values cannot "
+                    "be assembled across hosts")
                 if v.shape[1] % axis_size(spec[1]) == 0:
-                    return jax.device_put(v, pixel_sharding)
+                    return jax.device_put(v, NamedSharding(mesh, P(*spec[:2])))
                 return jax.device_put(v, rep)
             if getattr(v, "ndim", 0) == 3:
                 if process_local:
                     return jax.make_array_from_process_local_data(
                         self.microbatch_sharding, np.asarray(v))
                 return jax.device_put(v, self.microbatch_sharding)
+            if key == "labels" and getattr(v, "ndim", 0) == 2:
+                # sequence classification: one label per example [A, B] —
+                # the batch dim shards like the token arrays' (and per-host
+                # loaders hold only local rows, so replication would both
+                # violate host-invariance and mismatch the global logits)
+                sh = NamedSharding(mesh, P(*spec[:2]))
+                if process_local:
+                    return jax.make_array_from_process_local_data(
+                        sh, np.asarray(v))
+                return jax.device_put(v, sh)
             return jax.device_put(v, rep)
 
         return {k: place(k, v) for k, v in stacked.items()}
@@ -318,14 +339,24 @@ def stack_microbatches(microbatches) -> Dict[str, jnp.ndarray]:
     for k in sorted(keys):
         arrs = [np.asarray(mb[k]) for mb in microbatches]
         if k == "pixel_values":
-            # Image counts vary per microbatch; pad with zero-images at the
-            # END of the flat image list — the placeholder scatter consumes
-            # images in order, so trailing pads are never referenced.
-            max_imgs = max(a.shape[0] for a in arrs)
-            arrs = [
-                np.pad(a, [(0, max_imgs - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
-                for a in arrs
-            ]
+            # Image counts vary per microbatch.  Per-row slot layout
+            # [B, I, ...]: pad the slot dim I; legacy flat [B_img, ...]: pad
+            # the image list.  Trailing pads are never referenced (each
+            # row's placeholder count matches its real images).
+            if arrs[0].ndim == 5:
+                max_slots = max(a.shape[1] for a in arrs)
+                arrs = [
+                    np.pad(a, [(0, 0), (0, max_slots - a.shape[1])]
+                           + [(0, 0)] * (a.ndim - 2))
+                    for a in arrs
+                ]
+            else:
+                max_imgs = max(a.shape[0] for a in arrs)
+                arrs = [
+                    np.pad(a,
+                           [(0, max_imgs - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
+                    for a in arrs
+                ]
         else:
             max_s = max(a.shape[-1] for a in arrs)
             if any(a.shape[-1] != max_s for a in arrs):

@@ -57,8 +57,8 @@ def test_default_collate_shapes_and_masking(processor, samples):
     ids, labels, pv = batch["input_ids"], batch["labels"], batch["pixel_values"]
     B, S = ids.shape
     assert labels.shape == (B, S)
-    # NHWC float pixel batch, one image per sample
-    assert pv.shape == (B, 32, 32, 3) and pv.dtype == np.float32
+    # NHWC float pixels in per-row slots, one image per sample
+    assert pv.shape == (B, 1, 32, 32, 3) and pv.dtype == np.float32
     # every image contributes exactly n_patches placeholder tokens
     assert (ids == 7).sum() == B * processor.num_patches
     # image-token positions never contribute to the loss
@@ -175,3 +175,16 @@ def test_vlm_hf_roundtrip(tmp_path):
         lambda a, b: float(np.max(np.abs(np.asarray(a) - np.asarray(b)))),
         params, params2)
     assert max(jax.tree.leaves(diffs)) == 0.0
+
+
+def test_fixed_length_collation_is_host_invariant(processor, samples):
+    """fixed_length pins S regardless of which rows a host collates — the
+    shape agreement a per-host VLM input pipeline requires."""
+    lo = default_collate_fn(samples[:2], processor,
+                            start_of_response_token=RESPONSE_MARKER,
+                            fixed_length=96)
+    hi = default_collate_fn(samples[2:], processor,
+                            start_of_response_token=RESPONSE_MARKER,
+                            fixed_length=96)
+    assert lo["input_ids"].shape[1] == hi["input_ids"].shape[1] == 96
+    assert lo["labels"].shape == lo["input_ids"].shape
