@@ -29,6 +29,7 @@ from automodel_tpu.distributed.shardings import constrain
 from automodel_tpu.ops.attention import attention
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.quant import maybe_qdot
+from automodel_tpu.ops.remat import checkpoint_name, resolve_remat_policy
 from automodel_tpu.ops.rotary import apply_rope, rope_frequencies
 
 
@@ -191,6 +192,21 @@ class LlamaForCausalLM:
     def abstract_params(self) -> Dict[str, Any]:
         return jax.eval_shape(self.init, jax.random.key(0))
 
+    def hf_key_map(self):
+        """Family key map; int8 weight-only bases swap the quantized-module
+        kernels for streaming (int8, scale) spec pairs so HF bf16 checkpoints
+        quantize in the read callback (``quantization/weight_only.py``)."""
+        from automodel_tpu.models.registry import get_family
+
+        m = get_family(self.config.model_type).key_map_fn(self.config)
+        if self.weight_only_quant == "int8":
+            from automodel_tpu.quantization.weight_only import (
+                quantized_key_map,
+            )
+
+            m = quantized_key_map(m)
+        return m
+
     def param_axes(self) -> Dict[str, Any]:
         """Logical axis names per param (consumed by
         ``automodel_tpu.distributed.shardings``) — the TP/FSDP plan as data,
@@ -322,6 +338,7 @@ class LlamaForCausalLM:
                 segment_ids=segment_ids,
                 attention_mask=attention_mask,
             )
+        attn = checkpoint_name(attn, "attn_core")
         attn = proj(attn.reshape(B, S, Hq * D), p["self_attn"]["o_proj"],
                     "self_attn.o_proj")
         hidden = resid + attn
@@ -345,8 +362,8 @@ class LlamaForCausalLM:
         routing stats for the load-balancing aux loss; dense returns None)."""
         gate = proj(x, p["mlp"]["gate_proj"], "mlp.gate_proj")
         up = proj(x, p["mlp"]["up_proj"], "mlp.up_proj")
-        down = proj(jax.nn.silu(gate) * up, p["mlp"]["down_proj"],
-                    "mlp.down_proj")
+        act = checkpoint_name(jax.nn.silu(gate) * up, "mlp_silu")
+        down = proj(act, p["mlp"]["down_proj"], "mlp.down_proj")
         return down, None
 
     def __call__(
@@ -449,10 +466,9 @@ class LlamaForCausalLM:
             return h, (new_cache, aux)
 
         if self.remat and not decoding:
-            policy = None
-            if self.remat_policy and self.remat_policy != "none":
-                policy = getattr(jax.checkpoint_policies, self.remat_policy, None)
-            body = jax.checkpoint(body, policy=policy, prevent_cse=False)
+            body = jax.checkpoint(
+                body, policy=resolve_remat_policy(self.remat_policy),
+                prevent_cse=False)
         hidden, (new_cache, aux_losses) = lax.scan(
             body, hidden,
             (params["layers"], layer_adapters, layer_idx, kv_cache))

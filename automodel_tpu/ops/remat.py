@@ -1,0 +1,47 @@
+"""Rematerialization policies: how much of the layer forward to keep.
+
+The scan-stacked decoders wrap their layer body in ``jax.checkpoint``; the
+policy decides which in-layer intermediates survive to the backward pass
+(everything else is recomputed).  On a 16 GB v5e holding a 1B model's
+params + grads + Adam state (~12.5 GB), full ``dots_saveable`` OOMs, but a
+few *named* cheap-to-store / expensive-to-recompute tensors fit:
+
+* ``attn_core`` — the attention kernel output (pre-o_proj), ~32 MB/layer at
+  B4xS2048: saving it means the backward never re-runs the splash forward.
+* ``mlp_silu`` — ``silu(gate) * up`` (the down_proj input), ~128 MB/layer:
+  saving it skips the gate/up matmul recompute.
+
+Select with ``model.remat_policy: "save_names:attn_core"`` (comma-separate
+to save several); plain ``jax.checkpoint_policies`` attribute names
+(``nothing_saveable``, ``dots_saveable``, ...) still resolve directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+try:
+    from jax.ad_checkpoint import checkpoint_name
+except ImportError:  # pragma: no cover - very old jax
+    def checkpoint_name(x, name):
+        return x
+
+_PREFIX = "save_names:"
+
+
+def resolve_remat_policy(name: Optional[str]):
+    """Policy string -> jax.checkpoint policy callable (None = save nothing)."""
+    if not name or name == "none" or name == "nothing_saveable":
+        return None
+    if name.startswith(_PREFIX):
+        names = [n.strip() for n in name[len(_PREFIX):].split(",") if n.strip()]
+        return jax.checkpoint_policies.save_only_these_names(*names)
+    policy = getattr(jax.checkpoint_policies, name, None)
+    if policy is None:
+        raise ValueError(
+            f"Unknown remat policy {name!r}: use a jax.checkpoint_policies "
+            f"attribute or '{_PREFIX}<tag>[,<tag>...]' with tags "
+            "attn_core / mlp_silu")
+    return policy
