@@ -54,6 +54,7 @@ class HfSpec:
                  expert_stacked: bool = False,
                  load_transform: Optional[Callable] = None,
                  save_transform: Optional[Callable] = None,
+                 column_transform: Optional[Callable] = None,
                  missing_init: Optional[Callable] = None):
         self.template = template
         self.stacked = stacked
@@ -61,6 +62,13 @@ class HfSpec:
         self.transpose = transpose
         self.load_transform = load_transform
         self.save_transform = save_transform
+        # Column-local load transform for 2-D torch-Linear tensors: receives
+        # OUR layout (in_full, out_slice) — only the out columns of the
+        # requested slice are read (full contraction dim), so per-shard reads
+        # stay byte-ranged (a plain load_transform re-reads the whole tensor
+        # per shard).  The result's rows are then sliced by the request.
+        # Use for per-out-channel transforms (streaming int8 quantization).
+        self.column_transform = column_transform
         # (shape, dtype) -> np.ndarray used when the checkpoint lacks the
         # tensor: heads a base checkpoint does not carry (e.g. ``score.weight``
         # when fine-tuning a classifier from a causal-LM base — HF
@@ -339,7 +347,13 @@ def _hf_slice(spec: HfSpec, layer: Optional[int], idx: Tuple[slice, ...],
               expert: Optional[int] = None) -> np.ndarray:
     key = (spec.template.format(i=layer, e=expert) if spec.stacked
            else spec.template)
-    if spec.load_transform is not None:
+    if spec.column_transform is not None:
+        in_sl, out_sl = idx[-2], idx[-1]
+        # HF stores (out, in): reading (out_slice, :) is a contiguous
+        # byte-range; transpose to ours and transform per out column
+        raw = ckpt.get_slice(key, (out_sl, slice(None)))
+        arr = spec.column_transform(raw.T)[in_sl, :]
+    elif spec.load_transform is not None:
         arr = spec.load_transform(ckpt.get(key))[idx]
     elif spec.transpose:
         # requested (in, out) slice -> read (out, in) then transpose

@@ -16,6 +16,7 @@ from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 INT8_MAX = 127.0
 
@@ -70,31 +71,62 @@ def dequantize_base_params(params: Dict[str, Any],
     return out
 
 
-def load_quantized_hf_base(model, ckpt_dir: str, shardings=None):
-    """Stream HF bf16 weights, then quantize into the model's int8 layout.
+def quantize_kernel_np(w: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side :func:`quantize_kernel` for the streaming load path."""
+    w32 = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w32), axis=-2, keepdims=True)
+    scale = np.maximum(amax, 1e-12) / INT8_MAX
+    q = np.clip(np.round(w32 / scale), -INT8_MAX, INT8_MAX).astype(np.int8)
+    return q, scale.astype(np.float32)
 
-    ``model`` has ``weight_only_quant`` set; a flag-off twin supplies the
-    dense abstract tree for streaming, and the quantize transform runs
-    jitted with the final (quantized) shardings as outputs.
+
+def quantized_key_map(base_map):
+    """Rewrite a family key map so the QUANTIZED_MODULES kernels stream as
+    (int8 kernel, fp32 scale) pairs quantized host-side per safetensors
+    read — the dense bf16 tree never exists in HBM (reference loads
+    pre-quantized bitsandbytes weights directly, ``_peft/lora.py:308-314``;
+    HF ships bf16, so we quantize in the read callback instead).
+
+    Per-out-channel scales need the full contraction column, so the
+    transform runs as a ``column_transform``: the loader reads only the
+    requested OUT columns (full IN dim — a contiguous byte-range in the
+    torch (out, in) layout) and quantizes those, keeping per-shard reads
+    proportional to the shard.  The kernel and scale specs share the read
+    (2x the column bytes total) — still streaming, never the dense tree.
+    """
+    from automodel_tpu.models.hf_io import HfSpec
+
+    def no_save(*_a, **_k):
+        raise NotImplementedError(
+            "int8 QLoRA bases export via dequantize_base_params + the dense "
+            "key map, not the streaming quantized map")
+
+    m = dict(base_map)
+    for mod, proj in QUANTIZED_MODULES:
+        path = ("layers", mod, proj, "kernel")
+        spec = m.get(path)
+        if spec is None:
+            continue
+        m[path] = HfSpec(
+            spec.template, stacked=spec.stacked,
+            column_transform=lambda w: quantize_kernel_np(w)[0],
+            save_transform=no_save)
+        m[("layers", mod, proj, "scale")] = HfSpec(
+            spec.template, stacked=spec.stacked,
+            column_transform=lambda w: quantize_kernel_np(w)[1],
+            save_transform=no_save)
+    return m
+
+
+def load_quantized_hf_base(model, ckpt_dir: str, shardings=None):
+    """Stream HF bf16 weights directly INTO the int8 layout.
+
+    ``model`` has ``weight_only_quant`` set, so its abstract tree already
+    carries int8 kernels + scales and its ``hf_key_map`` routes the
+    quantized specs — each device shard materializes only quantized bytes
+    (~1.05 bytes/param for the frozen base), never the dense bf16 tree
+    (which at 70B would transiently double HBM and defeat QLoRA's point).
     """
     from automodel_tpu.models.hf_io import load_hf_weights
-    from automodel_tpu.models.llama import LlamaForCausalLM
 
-    twin = LlamaForCausalLM(
-        model.config, param_dtype=model.param_dtype,
-        compute_dtype=model.compute_dtype, remat=model.remat)
-
-    dense_shardings = None
-    if shardings is not None:
-        dense_shardings = jax.tree.map(lambda x: x, shardings)
-        layers = dense_shardings["layers"]
-        for mod, proj in QUANTIZED_MODULES:
-            node = dict(layers[mod][proj])
-            node.pop("scale", None)
-            layers[mod][proj] = node
-
-    dense = load_hf_weights(twin, ckpt_dir, shardings=dense_shardings)
-    quantize = jax.jit(quantize_base_params, donate_argnums=0,
-                       **({"out_shardings": shardings}
-                          if shardings is not None else {}))
-    return quantize(dense)
+    return load_hf_weights(model, ckpt_dir, shardings=shardings)
