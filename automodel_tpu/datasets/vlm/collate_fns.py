@@ -207,18 +207,93 @@ def _collate(examples: List[dict], processor,
     return out
 
 
+def _qwen_special(processor) -> Dict[str, int]:
+    """Special-token ids + merge size off a (real or mock) Qwen processor."""
+    tokenizer = getattr(processor, "tokenizer", processor)
+    convert = getattr(tokenizer, "convert_tokens_to_ids", None)
+    ids = {}
+    for name, tok, default in (
+            ("image_token_id", "<|image_pad|>", 151655),
+            ("video_token_id", "<|video_pad|>", 151656),
+            ("vision_start_token_id", "<|vision_start|>", 151652)):
+        v = convert(tok) if convert is not None else None
+        ids[name] = int(v) if v is not None else default
+    ids["spatial_merge_size"] = int(getattr(
+        getattr(processor, "image_processor", processor), "merge_size", 2))
+    return ids
+
+
 def qwen2_5_collate_fn(examples: List[dict], processor,
                        start_of_response_token: str = "<|im_start|>assistant\n",
                        pad_seq_len_divisible: Optional[int] = None,
-                       max_images_per_example: Optional[int] = None,
                        fixed_length: Optional[int] = None
                        ) -> Dict[str, np.ndarray]:
     """Qwen2.5-VL: im_start/assistant response marker (reference
-    ``collate_fns.py:120-148``)."""
-    return _collate(examples, processor, start_of_response_token,
-                    pad_seq_len_divisible=pad_seq_len_divisible,
-                    max_images_per_example=max_images_per_example,
-                    fixed_length=fixed_length)
+    ``collate_fns.py:120-148``).
+
+    Qwen's image processor emits FLAT patch rows ``[n_patches, C*tps*ps*ps]``
+    plus ``image_grid_thw`` — passed through as-is (the model consumes the
+    HF patch contract directly; the per-row slot layout of the other
+    collators is an image-tensor concept).  M-RoPE position ids ``[B, S, 3]``
+    are computed here, host-side (see ``datasets/vlm/qwen_rope.py``).
+    """
+    from automodel_tpu.datasets.vlm.qwen_rope import qwen_mrope_position_ids
+
+    texts = [processor.apply_chat_template(ex["conversation"], tokenize=False)
+             for ex in examples]
+    kwargs: Dict[str, Any] = dict(padding=True, return_tensors="np")
+    if fixed_length is not None:
+        kwargs.update(padding="max_length", truncation=True,
+                      max_length=int(fixed_length))
+    images = _gather_images(examples)
+    if images is not None:
+        kwargs["images"] = images
+    batch = processor(text=texts, **kwargs)
+
+    input_ids = _as_numpy(batch["input_ids"]).astype(np.int32)
+    attn = (None if batch.get("attention_mask") is None
+            else _as_numpy(batch["attention_mask"]).astype(np.int32))
+    out: Dict[str, np.ndarray] = {"input_ids": input_ids}
+    grid = None
+    if batch.get("pixel_values") is not None:
+        out["pixel_values"] = _as_numpy(batch["pixel_values"]).astype(
+            np.float32)
+        grid = _as_numpy(batch["image_grid_thw"]).astype(np.int32)
+        out["image_grid_thw"] = grid
+
+    loss_masks = [
+        create_loss_mask_with_start_of_response_token(
+            row, processor, start_of_response_token)
+        for row in input_ids
+    ]
+    out["labels"] = _shifted_masked_labels(
+        input_ids, extract_skipped_token_ids(processor), loss_masks)
+    out["loss_mask"] = np.asarray(loss_masks, np.float32)
+    sp = _qwen_special(processor)
+    if grid is not None:
+        # a truncated image span (fixed_length shorter than the expanded
+        # placeholders) would both crash the rope-index walk and misalign
+        # the feature scatter — fail with the cause, not a shape error
+        m = sp["spatial_merge_size"]
+        expect = int(sum(int(t) * (int(h) // m) * (int(w) // m)
+                         for t, h, w in grid))
+        got = int((input_ids == sp["image_token_id"]).sum())
+        if got != expect:
+            raise ValueError(
+                f"batch carries {got} image placeholder tokens but "
+                f"image_grid_thw implies {expect} — an image span was "
+                "truncated (raise fixed_length / max_length) or the "
+                "processor's placeholder expansion disagrees with the grid")
+    out["position_ids"] = qwen_mrope_position_ids(
+        input_ids, grid, attn, **sp)
+    if pad_seq_len_divisible:
+        pad = (-input_ids.shape[1]) % int(pad_seq_len_divisible)
+        _pad_text_fields(out, processor, int(pad_seq_len_divisible))
+        if pad:
+            out["position_ids"] = np.pad(
+                out["position_ids"], ((0, 0), (0, pad), (0, 0)),
+                constant_values=1)    # HF pads M-RoPE positions with 1
+    return out
 
 
 def phi4_mm_collate_fn(examples: List[dict], processor,

@@ -37,6 +37,9 @@ class _MockTokenizer:
     def get_vocab(self) -> Dict[str, int]:
         return dict(self._special)
 
+    def convert_tokens_to_ids(self, token: str) -> Optional[int]:
+        return self._special.get(token)
+
     def _word_id(self, word: str) -> int:
         if word in self._special:
             return self._special[word]
@@ -150,3 +153,94 @@ def make_mock_vlm_dataset(num_samples: int = 64, image_size: int = 32,
             "images": [img],
         })
     return out
+
+
+class Qwen2_5_VLProcessor:
+    """Mock with the REAL dispatch name: ``COLLATE_FNS`` routes by processor
+    class name, so this exercises the qwen2_5 collator + model end-to-end
+    offline.  Speaks the Qwen processor contract: chat template expands each
+    image to ``<|vision_start|>`` + one ``<|image_pad|>`` per MERGED unit,
+    ``__call__`` emits flat patch rows [n_patches, C*tps*ps*ps] +
+    ``image_grid_thw`` (the HF Qwen image-processor layout, merge-unit
+    grouped)."""
+
+    def __init__(self, vocab_size: int = 256, grid=(1, 4, 4),
+                 patch_size: int = 4, temporal_patch_size: int = 2,
+                 merge_size: int = 2, num_channels: int = 3):
+        self.grid = tuple(grid)
+        self.patch_size = patch_size
+        self.temporal_patch_size = temporal_patch_size
+        self.merge_size = merge_size
+        self.num_channels = num_channels
+        t, h, w = self.grid
+        self.n_units = t * (h // merge_size) * (w // merge_size)
+        self.image_size = (h * patch_size, w * patch_size)
+        self.tokenizer = _MockTokenizer(vocab_size, image_token_id=0)
+        self.tokenizer._special.update({
+            "<|vision_start|>": 5, "<|image_pad|>": 6, "<|vision_end|>": 7,
+            "<|im_start|>": 8, "<|im_end|>": 9, "assistant": 10, "user": 11,
+        })
+        self.image_processor = self           # exposes .merge_size
+
+    def apply_chat_template(self, conversation, tokenize=False, **_kw):
+        parts = []
+        for turn in conversation:
+            parts += ["<|im_start|>",
+                      "assistant" if turn["role"] == "assistant" else "user"]
+            content = turn["content"]
+            if isinstance(content, str):
+                parts.append(content)
+            else:
+                for c in content:
+                    if c.get("type") == "image":
+                        parts += (["<|vision_start|>"]
+                                  + ["<|image_pad|>"] * self.n_units
+                                  + ["<|vision_end|>"])
+                    elif c.get("type") == "text":
+                        parts.append(c["text"])
+            parts.append("<|im_end|>")
+        text = " ".join(parts)
+        return self.tokenizer(text)["input_ids"] if tokenize else text
+
+    def _patchify(self, img) -> np.ndarray:
+        t, h, w = self.grid
+        ps, tps, C = self.patch_size, self.temporal_patch_size, self.num_channels
+        arr = np.asarray(img, np.float32)
+        if arr.ndim == 2:
+            arr = np.stack([arr] * C, axis=-1)
+        hh, ww = h * ps, w * ps
+        yi = (np.arange(hh) * arr.shape[0] // hh).clip(0, arr.shape[0] - 1)
+        xi = (np.arange(ww) * arr.shape[1] // ww).clip(0, arr.shape[1] - 1)
+        arr = (arr[yi][:, xi] / 127.5 - 1.0)          # [hh, ww, C]
+        m = self.merge_size
+        # merge-unit-grouped patch order, (C, tps, ps, ps) flat rows
+        p = arr.reshape(h // m, m, ps, w // m, m, ps, C)
+        p = p.transpose(0, 3, 1, 4, 6, 2, 5)          # [gh, gw, m, m, C, ps, ps]
+        p = p.reshape(h * w, C, ps, ps)
+        p = np.repeat(p[:, :, None], tps, axis=2)     # temporal duplicate
+        p = np.tile(p.reshape(h * w, -1), (t, 1))
+        return p.astype(np.float32)                   # [t*h*w, C*tps*ps*ps]
+
+    def __call__(self, text, images=None, padding=True, return_tensors="np",
+                 truncation=False, max_length=None, **_kw):
+        seqs = [self.tokenizer(t)["input_ids"] for t in text]
+        if truncation and max_length:
+            seqs = [s[:max_length] for s in seqs]
+        width = max(len(s) for s in seqs)
+        if padding == "max_length" and max_length:
+            width = max_length
+        pad = self.tokenizer.pad_token_id
+        batch = {
+            "input_ids": np.asarray(
+                [s + [pad] * (width - len(s)) for s in seqs], np.int64),
+            "attention_mask": np.asarray(
+                [[1] * len(s) + [0] * (width - len(s)) for s in seqs],
+                np.int64),
+        }
+        if images is not None:
+            flat = [self._patchify(i) for imgs in images for i in imgs]
+            if flat:
+                batch["pixel_values"] = np.concatenate(flat, axis=0)
+                batch["image_grid_thw"] = np.asarray(
+                    [list(self.grid)] * len(flat), np.int64)
+        return batch

@@ -1,0 +1,93 @@
+"""Qwen2.5-VL M-RoPE position ids — host-side (collator) computation.
+
+Numpy port of ``Qwen2_5_VLModel.get_rope_index``
+(``transformers/models/qwen2_5_vl/modeling_qwen2_5_vl.py:956``): the 3D
+(temporal, height, width) position ids are a data-dependent function of the
+token stream (argwhere over vision-start markers, per-image spans), which is
+host work, not device work — the jitted TPU program receives them as plain
+``[B, S, 3]`` batch data (the model's M-RoPE hook consumes that layout).
+
+Text tokens advance all three axes together (1D rope); each image's span
+gets (t, h, w) grid coordinates offset past the preceding text; text after
+an image resumes at ``max(vision positions) + 1``.  Padding positions get 1
+(HF convention).  Videos additionally scale the temporal axis by
+``tokens_per_second * second_per_grid_t``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def qwen_mrope_position_ids(
+    input_ids: np.ndarray,                 # [B, S] int
+    image_grid_thw: Optional[np.ndarray],  # [N, 3] per-image (t, h, w)
+    attention_mask: Optional[np.ndarray] = None,   # [B, S] 1 = real token
+    *,
+    spatial_merge_size: int = 2,
+    image_token_id: int = 151655,
+    video_token_id: int = 151656,
+    vision_start_token_id: int = 151652,
+    video_grid_thw: Optional[np.ndarray] = None,
+    second_per_grid_ts: Optional[np.ndarray] = None,
+    tokens_per_second: int = 2,
+) -> np.ndarray:
+    """[B, S, 3] int32 (t, h, w) position ids."""
+    input_ids = np.asarray(input_ids)
+    B, S = input_ids.shape
+    if image_grid_thw is None and video_grid_thw is None:
+        if attention_mask is not None:
+            pos = np.cumsum(np.asarray(attention_mask), axis=-1) - 1
+            pos = np.where(np.asarray(attention_mask) == 0, 1, pos)
+        else:
+            pos = np.broadcast_to(np.arange(S), (B, S))
+        return np.repeat(pos[..., None], 3, axis=-1).astype(np.int32)
+
+    out = np.ones((B, S, 3), np.int64)
+    img_i = vid_i = 0
+    for b in range(B):
+        row = input_ids[b]
+        keep = (np.asarray(attention_mask[b]) == 1
+                if attention_mask is not None else np.ones(S, bool))
+        toks = row[keep]
+        starts = np.nonzero(toks == vision_start_token_id)[0]
+        vision_kinds = toks[starts + 1] if len(starts) else np.array([], int)
+        pieces = []
+        st = 0
+        for kind in vision_kinds:
+            if kind == image_token_id:
+                ed = int(np.nonzero(toks[st:] == image_token_id)[0][0]) + st
+                t, h, w = (int(x) for x in image_grid_thw[img_i])
+                per_t = 0.0
+                img_i += 1
+            else:
+                ed = int(np.nonzero(toks[st:] == video_token_id)[0][0]) + st
+                t, h, w = (int(x) for x in video_grid_thw[vid_i])
+                per_t = (float(second_per_grid_ts[vid_i])
+                         if second_per_grid_ts is not None else 1.0)
+                vid_i += 1
+            gh, gw = h // spatial_merge_size, w // spatial_merge_size
+            text_len = ed - st
+            base = pieces[-1].max() + 1 if pieces else 0
+            pieces.append(np.broadcast_to(
+                np.arange(text_len) + base, (3, text_len)).copy())
+            t_idx = (np.arange(t)[:, None]
+                     * per_t * tokens_per_second).astype(np.int64)
+            t_idx = np.broadcast_to(t_idx, (t, gh * gw)).reshape(-1)
+            h_idx = np.broadcast_to(
+                np.arange(gh)[None, :, None], (t, gh, gw)).reshape(-1)
+            w_idx = np.broadcast_to(
+                np.arange(gw)[None, None, :], (t, gh, gw)).reshape(-1)
+            pieces.append(np.stack([t_idx, h_idx, w_idx]) + text_len + base)
+            st = ed + t * gh * gw
+        if st < len(toks):
+            base = pieces[-1].max() + 1 if pieces else 0
+            text_len = len(toks) - st
+            pieces.append(np.broadcast_to(
+                np.arange(text_len) + base, (3, text_len)).copy())
+        if pieces:
+            pos3 = np.concatenate(pieces, axis=1)       # [3, n_keep]
+            out[b, keep, :] = pos3.T
+    return out.astype(np.int32)

@@ -253,6 +253,55 @@ def gemma3_vlm_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
     return m
 
 
+def qwen2_5_vl_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    """Qwen2.5-VL (HF ``Qwen2_5_VLForConditionalGeneration``): text under
+    ``model.language_model.``, windowed ViT under ``model.visual.``; the
+    conv3d patch embed (out, C, tps, ps, ps) flattens to our patch matmul
+    (C*tps*ps*ps, out)."""
+    m: Dict[Tuple[str, ...], HfSpec] = {}
+    for path, spec in llama_key_map(config.text_config).items():
+        t = spec.template
+        if t.startswith("model."):
+            t = "model.language_model." + t[len("model."):]
+        m[("language_model",) + path] = HfSpec(
+            t, stacked=spec.stacked, transpose=spec.transpose)
+
+    def conv_to_matmul(w: np.ndarray) -> np.ndarray:
+        return w.reshape(w.shape[0], -1).T          # (out, pdim) -> (pdim, out)
+
+    def matmul_to_conv(w: np.ndarray) -> np.ndarray:
+        vc = config.vision_config
+        return w.T.reshape(-1, vc.in_channels, vc.temporal_patch_size,
+                           vc.patch_size, vc.patch_size)
+
+    m[("visual", "patch_embed", "kernel")] = HfSpec(
+        "model.visual.patch_embed.proj.weight",
+        load_transform=conv_to_matmul, save_transform=matmul_to_conv)
+    pre = "model.visual.blocks.{i}."
+    m[("visual", "blocks", "norm1", "weight")] = HfSpec(
+        pre + "norm1.weight", stacked=True)
+    m[("visual", "blocks", "norm2", "weight")] = HfSpec(
+        pre + "norm2.weight", stacked=True)
+    for mod, name in (("qkv", "attn.qkv"), ("proj", "attn.proj")):
+        m[("visual", "blocks", "attn", mod, "kernel")] = HfSpec(
+            pre + name + ".weight", stacked=True, transpose=True)
+        m[("visual", "blocks", "attn", mod, "bias")] = HfSpec(
+            pre + name + ".bias", stacked=True)
+    for proj in ("gate_proj", "up_proj", "down_proj"):
+        m[("visual", "blocks", "mlp", proj, "kernel")] = HfSpec(
+            pre + f"mlp.{proj}.weight", stacked=True, transpose=True)
+        m[("visual", "blocks", "mlp", proj, "bias")] = HfSpec(
+            pre + f"mlp.{proj}.bias", stacked=True)
+    m[("visual", "merger", "ln_q", "weight")] = HfSpec(
+        "model.visual.merger.ln_q.weight")
+    for ours, theirs in (("fc1", "mlp.0"), ("fc2", "mlp.2")):
+        m[("visual", "merger", ours, "kernel")] = HfSpec(
+            f"model.visual.merger.{theirs}.weight", transpose=True)
+        m[("visual", "merger", ours, "bias")] = HfSpec(
+            f"model.visual.merger.{theirs}.bias")
+    return m
+
+
 def _key_map_for(model) -> Dict[Tuple[str, ...], HfSpec]:
     from automodel_tpu.models.registry import get_family
 
@@ -275,6 +324,7 @@ def _key_map_for(model) -> Dict[Tuple[str, ...], HfSpec]:
 # _checkpoint_conversion_mapping role in transformers).
 _LEGACY_KEY_RENAMES = (
     ("model.language_model.", "language_model.model."),
+    ("model.language_model.", "model."),      # qwen2.5-vl legacy flat naming
     ("model.vision_tower.", "vision_tower."),
     ("model.multi_modal_projector.", "multi_modal_projector."),
     ("model.audio_tower.", "audio_tower."),

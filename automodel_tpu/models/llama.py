@@ -251,6 +251,11 @@ class LlamaForCausalLM:
         return axes
 
     # -- forward -----------------------------------------------------------
+    def _apply_rope(self, q, k, position_ids, inv_freq):
+        """RoPE hook: Qwen2.5-VL overrides with multimodal 3-section rope
+        (position_ids [B, S, 3])."""
+        return apply_rope(q, k, position_ids, inv_freq)
+
     def _decoder_layer(self, hidden, layer_params, position_ids, segment_ids,
                        attention_mask, inv_freq, adapters=None,
                        adapter_scale=1.0, adapter_dropout=0.0,
@@ -306,7 +311,7 @@ class LlamaForCausalLM:
         if cfg.qk_norm:
             q = rms_norm(q, p["self_attn"]["q_norm"]["weight"], cfg.rms_norm_eps)
             k = rms_norm(k, p["self_attn"]["k_norm"]["weight"], cfg.rms_norm_eps)
-        q, k = apply_rope(q, k, position_ids, inv_freq)
+        q, k = self._apply_rope(q, k, position_ids, inv_freq)
         new_cache = None
         if kv_cache is not None:
             # Autoregressive decode: write this step's k/v into the static

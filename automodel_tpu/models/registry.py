@@ -89,3 +89,20 @@ def _ensure_builtin() -> None:
     register_model(ModelFamily("llava", VLMConfig, VLMForConditionalGeneration,
                                hf_io.vlm_key_map,
                                ["LlavaForConditionalGeneration"]))
+    from automodel_tpu.models.qwen2_5_vl import (
+        Qwen25VLConfig,
+        Qwen25VLForConditionalGeneration,
+    )
+
+    register_model(ModelFamily("qwen2_5_vl", Qwen25VLConfig,
+                               Qwen25VLForConditionalGeneration,
+                               hf_io.qwen2_5_vl_key_map,
+                               ["Qwen2_5_VLForConditionalGeneration"]))
+    from automodel_tpu.models.qwen2_5_vl import (
+        Qwen25VLTextConfig,
+        Qwen25VLTextModel,
+    )
+
+    register_model(ModelFamily("qwen2_5_vl_text", Qwen25VLTextConfig,
+                               Qwen25VLTextModel, hf_io.llama_key_map,
+                               ["Qwen2_5_VLTextModel"]))

@@ -129,6 +129,16 @@ class TrainStepFns:
             return size
 
         def place(key, v):
+            if key == "image_grid_thw":
+                # [A, N, 3] image-grid metadata: host-invariant, replicated
+                return jax.device_put(v, rep)
+            if key == "position_ids" and getattr(v, "ndim", 0) == 4:
+                # M-RoPE ids [A, B, S, 3]: batch/seq shard like the tokens
+                sh = NamedSharding(mesh, P(*spec, None))
+                if process_local:
+                    return jax.make_array_from_process_local_data(
+                        sh, np.asarray(v))
+                return jax.device_put(v, sh)
             if key == "pixel_values":
                 ndim = getattr(v, "ndim", 0)
                 if ndim == 6:
@@ -357,6 +367,18 @@ def stack_microbatches(microbatches) -> Dict[str, jnp.ndarray]:
                            [(0, max_imgs - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
                     for a in arrs
                 ]
+        elif k == "image_grid_thw":
+            # image counts vary per microbatch: zero-pad the image dim
+            max_n = max(a.shape[0] for a in arrs)
+            arrs = [np.pad(a, [(0, max_n - a.shape[0]), (0, 0)])
+                    for a in arrs]
+        elif k == "position_ids" and arrs[0].ndim == 3:
+            # M-RoPE ids [B, S, 3]: the padded dim is S, not the trailing
+            # section axis; pad value 1 (the HF masked-position convention)
+            max_s = max(a.shape[1] for a in arrs)
+            arrs = [np.pad(a, [(0, 0), (0, max_s - a.shape[1]), (0, 0)],
+                           constant_values=1)
+                    for a in arrs]
         else:
             max_s = max(a.shape[-1] for a in arrs)
             if any(a.shape[-1] != max_s for a in arrs):
