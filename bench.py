@@ -1,20 +1,33 @@
 """Benchmark: Llama-1B training throughput through the REAL recipe path.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "secondary"}.
 
-Drives ``examples/llm_finetune/llama3_2/llama3_2_1b_bench.yaml`` — the
-north-star hellaswag recipe with offline fixtures — through
-``TrainFinetuneRecipeForNextTokenPrediction.setup()`` and
-``_run_train_optim_step``, so the measured number is what a user of the
-YAML recipes actually gets (bf16 params from the checkpoint torch_dtype,
-fused-linear CE, splash attention, packed sequences).  ``vs_baseline`` is
-MFU / 0.40 (the ≥40% MFU v5e target from BASELINE.md).
+The primary metric drives ``examples/llm_finetune/llama3_2/
+llama3_2_1b_bench.yaml`` — the north-star hellaswag recipe with offline
+fixtures — through ``TrainFinetuneRecipeForNextTokenPrediction.setup()`` and
+``_run_train_optim_step``, so the measured number is what a user of the YAML
+recipes actually gets (bf16 params from the checkpoint torch_dtype, the
+Pallas fused-linear CE kernel, splash attention, packed sequences).
+``vs_baseline`` is MFU / 0.40 (the ≥40% MFU v5e target from BASELINE.md).
+
+``secondary`` tracks the rest of the BASELINE.md config matrix at single-chip
+scale, each in its own subprocess (fresh HBM):
+  * ``unpacked``  — the user-facing unpacked path (packed_sequence_size 0,
+    pad-to-128 default → splash fast path), config #1's common variant;
+  * ``peft``      — LoRA fine-tune (config #2);
+  * ``qlora_int8``— LoRA over the int8 weight-only base;
+  * ``vlm``       — image-text-to-text SFT scale-down (config #4) on the
+    mock conversation set via the VLM recipe.
+Secondary failures record null instead of failing the bench.  Set
+``BENCH_MATRIX=0`` for the primary-only fast path.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -22,13 +35,125 @@ import numpy as np
 # v5e peak bf16 TFLOP/s per chip; override for other TPU generations.
 PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
 SMALL = bool(int(os.environ.get("BENCH_SMALL", "0")))
-YAML = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                    "examples", "llm_finetune", "llama3_2",
+ROOT = os.path.dirname(os.path.abspath(__file__))
+YAML = os.path.join(ROOT, "examples", "llm_finetune", "llama3_2",
                     "llama3_2_1b_bench.yaml")
+VLM_YAML = os.path.join(ROOT, "examples", "vlm_finetune",
+                        "tiny_vlm_mock.yaml")
+
+SMALL_OVERRIDES = [
+    "--model.config.hidden_size", "256",
+    "--model.config.intermediate_size", "1024",
+    "--model.config.num_hidden_layers", "4",
+    "--model.config.num_attention_heads", "8",
+    "--model.config.num_key_value_heads", "4",
+    "--model.config.head_dim", "32",
+    "--model.config.vocab_size", "2048",
+    "--dataset.num_sentences", "64",
+    "--dataset.mean_len", "96",
+    "--dataset.max_sentence_len", "127",
+    "--packed_sequence.packed_sequence_size", "512",
+]
+
+SECONDARY = {
+    "unpacked": [
+        "--packed_sequence.packed_sequence_size", "0",
+        # tight length distribution: the 128-bucketing then yields one
+        # stable [B, S] shape after warmup instead of a compile per bucket
+        "--dataset.mean_len", "1000", "--dataset.std_len", "30",
+        "--dataset.max_sentence_len", "1100",
+    ],
+    "peft": [
+        "--peft.target_modules", "['*_proj']",
+        "--peft.dim", "8", "--peft.alpha", "16",
+    ],
+    "qlora_int8": [
+        "--peft.target_modules", "['*_proj']",
+        "--peft.dim", "8", "--peft.alpha", "16",
+        "--peft.quantize_base", "int8",
+    ],
+}
+
+
+def _run_recipe(recipe_cls, yaml, overrides, steps, warmup):
+    from automodel_tpu.config.arg_parser import parse_args_and_load_config
+
+    cfg = parse_args_and_load_config(["--config", yaml] + overrides)
+    recipe = recipe_cls(cfg).setup()
+
+    def stream():
+        while True:
+            yielded = False
+            for g in recipe.step_scheduler:
+                yielded = True
+                yield g
+            if not yielded:
+                raise RuntimeError("step scheduler yielded no batches")
+
+    groups = stream()
+
+    def one_step():
+        batches = next(groups)
+        tokens = sum(int(np.asarray(b["input_ids"]).size) for b in batches)
+        return recipe._run_train_optim_step(batches), tokens
+
+    for _ in range(warmup):
+        one_step()
+    recipe.flush_metrics()   # drain in-flight work before the timed window
+
+    t0 = time.perf_counter()
+    total_tokens = 0
+    for _ in range(steps):
+        _, tokens = one_step()
+        total_tokens += tokens
+    m = recipe.flush_metrics()  # device-syncs the last dispatched step
+    dt = time.perf_counter() - t0
+    assert np.isfinite(m["loss"])
+    return total_tokens / dt, recipe
+
+
+def _secondary_main(name: str) -> None:
+    """Child process: one secondary config, prints {"tps": ...}."""
+    steps, warmup = (4, 2) if SMALL else (8, 3)
+    if name == "vlm":
+        from automodel_tpu.recipes.vlm.finetune import FinetuneRecipeForVLM
+
+        overrides = ["--checkpoint.enabled", "false",
+                     "--step_scheduler.max_steps", str(steps + warmup + 2),
+                     "--dataset.num_samples", "256",
+                     "--step_scheduler.num_epochs", "1000"]
+        tps, _ = _run_recipe(FinetuneRecipeForVLM, VLM_YAML, overrides,
+                             steps, warmup)
+    else:
+        from automodel_tpu.recipes.llm.train_ft import (
+            TrainFinetuneRecipeForNextTokenPrediction,
+        )
+
+        overrides = list(SECONDARY[name])
+        if SMALL:
+            # shrink applies first so the secondary override wins on clashes
+            overrides = SMALL_OVERRIDES + overrides
+        tps, _ = _run_recipe(TrainFinetuneRecipeForNextTokenPrediction,
+                             YAML, overrides, steps, warmup)
+    print(json.dumps({"tps": round(tps, 1)}))
+
+
+def _collect_secondary() -> dict:
+    out = {}
+    for name in list(SECONDARY) + ["vlm"]:
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--secondary", name],
+                capture_output=True, text=True, timeout=900, cwd=ROOT)
+            line = proc.stdout.strip().splitlines()[-1]
+            out[name] = json.loads(line)["tps"]
+        except Exception:
+            out[name] = None
+    return out
 
 
 def main() -> None:
-    from automodel_tpu.config.arg_parser import parse_args_and_load_config
     from automodel_tpu.recipes.llm.train_ft import (
         TrainFinetuneRecipeForNextTokenPrediction,
     )
@@ -39,54 +164,32 @@ def main() -> None:
         overrides += ["--fp8.enabled", "true", "--fp8.dtype", quant,
                       "--fp8.recipe_name", "tensorwise"]
     if SMALL:
-        overrides += [
-            "--model.config.hidden_size", "256",
-            "--model.config.intermediate_size", "1024",
-            "--model.config.num_hidden_layers", "4",
-            "--model.config.num_attention_heads", "8",
-            "--model.config.num_key_value_heads", "4",
-            "--model.config.head_dim", "32",
-            "--model.config.vocab_size", "2048",
-            "--dataset.num_sentences", "64",
-            "--dataset.mean_len", "96",
-            "--dataset.max_sentence_len", "127",
-            "--packed_sequence.packed_sequence_size", "512",
-        ]
+        overrides += SMALL_OVERRIDES
     steps, warmup = (5, 2) if SMALL else (10, 3)
 
-    cfg = parse_args_and_load_config(["--config", YAML] + overrides)
-    recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+    # children first: they need the chip to themselves, and this parent has
+    # not initialized a jax client yet at this point
+    secondary = (_collect_secondary()
+                 if os.environ.get("BENCH_MATRIX", "1") != "0" else None)
 
-    groups = iter(recipe.step_scheduler)
-
-    def one_step():
-        batches = next(groups)
-        tokens = sum(int(np.asarray(b["input_ids"]).size) for b in batches)
-        return recipe._run_train_optim_step(batches), tokens
-
-    for _ in range(warmup):
-        m, _ = one_step()
-
-    recipe.flush_metrics()   # drain in-flight work before the timed window
-
-    t0 = time.perf_counter()
-    total_tokens = 0
-    for _ in range(steps):
-        m, tokens = one_step()
-        total_tokens += tokens
-    m = recipe.flush_metrics()  # device-syncs the last dispatched step
-    dt = time.perf_counter() - t0
-    assert np.isfinite(m["loss"])
-
-    tokens_per_sec = total_tokens / dt
+    tokens_per_sec, recipe = _run_recipe(
+        TrainFinetuneRecipeForNextTokenPrediction, YAML, overrides,
+        steps, warmup)
     mfu = tokens_per_sec * recipe.model.flops_per_token() / PEAK_FLOPS
-    print(json.dumps({
+
+    result = {
         "metric": "llama1b_sft_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 4),
-    }))
+    }
+    if secondary is not None:
+        result["secondary"] = secondary
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) == 3 and sys.argv[1] == "--secondary":
+        _secondary_main(sys.argv[2])
+    else:
+        main()
