@@ -23,6 +23,67 @@ _IGNORED_TORCH_KWARGS = {
 }
 
 
+def _group_multipliers(param_groups, params) -> Tuple[Any, Any, bool, bool]:
+    """(lr_mults, wd_mults, any_lr, any_wd) pytrees from a ``param_groups``
+    list of ``{"params": [patterns...], "lr_mult": x, "wd_mult": y}`` —
+    the reference's per-group multipliers (``optim/scheduler.py:143,206-218``)
+    as static per-leaf scale trees (first matching group wins)."""
+    from automodel_tpu.peft.module_matcher import wildcard_match
+    from automodel_tpu.utils.pytree import (
+        flatten_path_dict,
+        unflatten_path_dict,
+    )
+
+    flat = flatten_path_dict(params)
+    lr_f, wd_f = {}, {}
+    any_lr = any_wd = False
+    for path in flat:
+        name = ".".join(path)
+        lr_m = wd_m = 1.0
+        for g in param_groups:
+            pats = g.get("params") or g.get("patterns") or []
+            if any(wildcard_match(p, name) for p in pats):
+                lr_m = float(g.get("lr_mult", 1.0))
+                wd_m = float(g.get("wd_mult", 1.0))
+                break
+        any_lr |= lr_m != 1.0
+        any_wd |= wd_m != 1.0
+        lr_f[path], wd_f[path] = lr_m, wd_m
+    return (unflatten_path_dict(lr_f), unflatten_path_dict(wd_f),
+            any_lr, any_wd)
+
+
+def _scale_by_tree(mults) -> optax.GradientTransformation:
+    import jax as _jax
+
+    def init(params):
+        return optax.EmptyState()
+
+    def update(updates, state, params=None):
+        return _jax.tree.map(lambda u, m: u * m, updates, mults), state
+
+    return optax.GradientTransformation(init, update)
+
+
+def _scale_wd(weight_decay, wd_mults) -> optax.GradientTransformation:
+    """``add_decayed_weights`` with a static per-leaf multiplier on the
+    (injected, traced) base weight decay."""
+    import jax as _jax
+
+    def init(params):
+        return optax.EmptyState()
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("weight decay needs params")
+        updates = _jax.tree.map(
+            lambda u, p, m: u + weight_decay * m * p.astype(u.dtype),
+            updates, params, wd_mults)
+        return updates, state
+
+    return optax.GradientTransformation(init, update)
+
+
 def build_optimizer(
     name: str = "adamw",
     lr: float = 1e-4,
@@ -33,6 +94,8 @@ def build_optimizer(
     grad_clip_norm: Optional[float] = None,
     mask: Optional[Any] = None,
     mu_dtype: Optional[Any] = None,
+    param_groups: Optional[Sequence[dict]] = None,
+    params: Optional[Any] = None,
     **kwargs,
 ) -> optax.GradientTransformation:
     """Build an injectable-hyperparam optax optimizer.
@@ -43,6 +106,10 @@ def build_optimizer(
     optimizer chain (the reference clips separately at
     ``recipes/llm/train_ft.py:689-698``; keeping it in-chain lets the whole
     update stay one XLA program).
+    ``param_groups`` + ``params`` (abstract tree): per-group ``lr_mult`` /
+    ``wd_mult`` by wildcard-matched leaf path (reference
+    ``optim/scheduler.py:143``); the scheduler's base lr/wd still drive the
+    injected hyperparams, multipliers are static per-leaf scales.
     """
     for k in list(kwargs):
         if k in _IGNORED_TORCH_KWARGS:
@@ -54,6 +121,18 @@ def build_optimizer(
     b1, b2 = float(betas[0]), float(betas[1])
     name = name.lower().replace("torch.optim.", "")
 
+    lr_mults = wd_mults = None
+    if param_groups:
+        if params is None:
+            raise ValueError(
+                "param_groups needs the abstract params tree to resolve "
+                "patterns (the recipe passes it automatically)")
+        groups = [g.to_dict() if hasattr(g, "to_dict") else dict(g)
+                  for g in param_groups]
+        lr_t, wd_t, any_lr, any_wd = _group_multipliers(groups, params)
+        lr_mults = lr_t if any_lr else None
+        wd_mults = wd_t if any_wd else None
+
     @optax.inject_hyperparams
     def make(learning_rate, weight_decay):
         chain = []
@@ -63,12 +142,18 @@ def build_optimizer(
             chain.append(optax.scale_by_adam(
                 b1=b1, b2=b2, eps=float(eps), mu_dtype=mu_dtype))
             if name == "adamw":
-                chain.append(optax.add_decayed_weights(weight_decay))
+                if wd_mults is not None:
+                    chain.append(_scale_wd(weight_decay, wd_mults))
+                else:
+                    chain.append(optax.add_decayed_weights(weight_decay))
         elif name == "sgd":
             # torch.optim.SGD couples wd into the gradient *before* the
             # momentum buffer (d_p += wd*p, then buf = m*buf + d_p).
             if weight_decay is not None:
-                chain.append(optax.add_decayed_weights(weight_decay))
+                if wd_mults is not None:
+                    chain.append(_scale_wd(weight_decay, wd_mults))
+                else:
+                    chain.append(optax.add_decayed_weights(weight_decay))
             if momentum:
                 chain.append(optax.trace(decay=float(momentum)))
         elif name == "adafactor":
@@ -76,6 +161,8 @@ def build_optimizer(
         else:
             raise ValueError(f"Unknown optimizer {name!r}")
         chain.append(optax.scale_by_learning_rate(learning_rate))
+        if lr_mults is not None:
+            chain.append(_scale_by_tree(lr_mults))
         return optax.chain(*chain)
 
     tx = make(learning_rate=float(lr), weight_decay=float(weight_decay))

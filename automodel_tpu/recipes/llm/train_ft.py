@@ -289,6 +289,16 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 opt_kwargs.setdefault("grad_clip_norm", float(max_gn))
             if isinstance(target, str):
                 opt_kwargs.setdefault("name", target.rsplit(".", 1)[-1].lower())
+            if opt_kwargs.get("param_groups"):
+                # per-group lr_mult/wd_mult resolve against the tree the
+                # optimizer actually updates (the trainable subtree under
+                # PEFT/freezing) — reference optim/scheduler.py:143
+                abs_p = self.model.abstract_params()
+                if mask is not None:
+                    from automodel_tpu.utils.pytree import partition
+
+                    abs_p = partition(abs_p, mask)[0]
+                opt_kwargs["params"] = abs_p
             # Freezing via the train step's trainable-subtree mode: grads,
             # accumulation buffers and optimizer state exist only for the
             # trainable leaves (vs optax.masked, which still pays a

@@ -98,3 +98,34 @@ def test_masked_optimizer_freezes():
     out = optax.apply_updates(params, updates)
     np.testing.assert_allclose(np.asarray(out["base"]), np.asarray(params["base"]))
     assert not np.allclose(np.asarray(out["lora"]), np.asarray(params["lora"]))
+
+
+def test_param_group_lr_wd_multipliers():
+    """Per-group lr_mult/wd_mult (reference optim/scheduler.py:143): matched
+    leaves step at lr*lr_mult and decay at wd*wd_mult; the injected base
+    lr/wd still drive the schedule."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from automodel_tpu.optim import build_optimizer, set_hyperparams
+
+    params = {"embed": {"w": jnp.ones((4,))}, "head": {"w": jnp.ones((4,))}}
+    tx = build_optimizer(
+        name="adamw", lr=1.0, weight_decay=0.1,
+        param_groups=[{"params": ["embed*"], "lr_mult": 0.5, "wd_mult": 0.0}],
+        params=params)
+    state = tx.init(params)
+    grads = jax.tree.map(jnp.ones_like, params)
+    updates, state = tx.update(grads, state, params)
+    # adam first step: unit update magnitude (|g|/sqrt(g^2)) -> -lr*(1 + wd)
+    np.testing.assert_allclose(
+        np.asarray(updates["head"]["w"]), -1.0 * (1.0 + 0.1), rtol=1e-4)
+    # embed: lr_mult 0.5, wd off
+    np.testing.assert_allclose(
+        np.asarray(updates["embed"]["w"]), -0.5 * 1.0, rtol=1e-4)
+    # schedule still drives via the injected scalars
+    state = set_hyperparams(state, lr=0.2)
+    updates, _ = tx.update(grads, state, params)
+    np.testing.assert_allclose(
+        np.asarray(updates["embed"]["w"]), -0.1, rtol=1e-3)
