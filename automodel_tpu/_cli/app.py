@@ -73,6 +73,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                                   overrides=overrides)
         logger.info("Submitted SLURM job %s", job_id)
         return 0
+    if cfg.get("k8s") is not None:
+        # GKE TPU-slice launch (NotImplementedError in the reference,
+        # ``_cli/app.py:286-287``)
+        from automodel_tpu.launcher.k8s.utils import submit_k8s_job
+
+        path = submit_k8s_job(cfg, args.command, args.domain, args.config,
+                              overrides=overrides)
+        logger.info("Rendered k8s job manifest %s (kubectl apply -f %s)",
+                    path, path)
+        return 0
 
     recipe_main = load_function(RECIPES[key])
     recipe_main(argv=["--config", args.config] + overrides)

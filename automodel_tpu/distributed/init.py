@@ -68,6 +68,13 @@ def initialize_distributed(
             "TPU_WORKER_HOSTNAMES", "SLURM_JOB_ID", "MEGASCALE_COORDINATOR_ADDRESS",
         )
     )
+    # k8s indexed-Job bootstrap (``launcher/k8s``): jax itself only reads the
+    # coordinator address from env, so the pod's completion-index-derived
+    # process id and host count arrive through these two variables.
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
     explicit = coordinator_address is not None or num_processes is not None
     single_host = os.environ.get("TPU_WORKER_HOSTNAMES", "") in ("", "localhost")
     if not _INITIALIZED and (explicit or (cluster_env and not single_host)):
