@@ -148,7 +148,17 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
         # per-row image slots ([B, I, H, W, C]).  Hosts must agree on shapes:
         # set dataloader.max_images_per_example for multi-image data.
         self._host_rows = None
-        if jax.process_count() > 1:
+        flat_patch_family = "image_grid_thw" in getattr(
+            self.model, "extra_batch_keys", ())
+        if jax.process_count() > 1 and flat_patch_family:
+            # Qwen-style flat [n_patches, pdim] pixel streams have no
+            # per-row slot layout to assemble across hosts — stay on the
+            # global loader (every host processes the full batch)
+            logger.warning(
+                "%s uses the flat-patch pixel contract: per-host input "
+                "sharding is disabled (global loader on every host)",
+                type(self.model).__name__)
+        elif jax.process_count() > 1:
             from automodel_tpu.distributed.shardings import process_batch_rows
 
             self._host_rows = process_batch_rows(

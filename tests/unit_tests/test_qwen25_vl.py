@@ -171,3 +171,41 @@ def test_greedy_generate_matches_transformers(tmp_path):
         hf_out = hf.generate(torch.from_numpy(prompt), max_new_tokens=6,
                              do_sample=False, pad_token_id=0)
     np.testing.assert_array_equal(ours[0], hf_out[0, 9:].numpy())
+
+
+def test_window_partition_with_padding_matches_transformers(tmp_path):
+    """A grid whose merged rows do NOT divide the window (llm 3x2 vs 2x2
+    windows): exercises the real window partition — multiple windows, pad
+    slots, masked attention, inverse scatter — against HF (the base GRID
+    degenerates to one full window)."""
+    grid = (1, 6, 4)            # llm grid 3x2, wlen 2 -> pad_h 1, 2 windows
+    cfg_dict = dict(TINY)
+    model = Qwen25VLForConditionalGeneration(
+        Qwen25VLConfig.from_hf_config(cfg_dict),
+        param_dtype=jnp.float32, compute_dtype=jnp.float32,
+        remat=False, image_grid=grid)
+    params = _randomized(model, jax.random.key(5))
+    hf = _export(model, params, tmp_path)
+
+    rng = np.random.default_rng(5)
+    t, h, w = grid
+    n_units = t * (h // 2) * (w // 2)
+    ids = np.asarray(
+        [rng.integers(1, 90, 4).tolist() + [VSTART] + [IMG] * n_units
+         + rng.integers(1, 90, 5).tolist()], np.int64)
+    pdim = 3 * 2 * 4 * 4
+    patches = rng.normal(size=(t * h * w, pdim)).astype(np.float32)
+    hf_grid = np.asarray([[t, h, w]], np.int64)
+    with torch.no_grad():
+        ref = hf(input_ids=torch.from_numpy(ids),
+                 pixel_values=torch.from_numpy(patches),
+                 image_grid_thw=torch.from_numpy(hf_grid)).logits.numpy()
+    pos = qwen_mrope_position_ids(
+        ids, hf_grid, None, spatial_merge_size=2, image_token_id=IMG,
+        video_token_id=VID, vision_start_token_id=VSTART)
+    ours = model(params, jnp.asarray(ids, jnp.int32),
+                 pixel_values=jnp.asarray(patches),
+                 image_grid_thw=jnp.asarray(hf_grid, jnp.int32),
+                 position_ids=jnp.asarray(pos))["logits"]
+    np.testing.assert_allclose(np.asarray(ours, np.float32), ref,
+                               atol=3e-4, rtol=3e-3)
