@@ -305,10 +305,9 @@ def phi4_mm_collate_fn(examples: List[dict], processor,
     token ids inside ``input_ids`` (no chat-template response marker), and
     image-embed side tensors are dropped.
 
-    NOTE: no registered model family consumes the audio keys this emits yet;
-    the train step fails loudly on unconsumed batch keys rather than train
-    with the audio context silently dropped — pair this collator with an
-    audio-capable model (``extra_batch_keys``) when one lands."""
+    Pairs with ``models/phi4_mm.py`` (``Phi4MMForCausalLM`` declares the
+    audio keys via ``extra_batch_keys``); any other model still fails loudly
+    on the unconsumed audio keys rather than silently dropping the audio."""
     conversations = [ex["conversation"] for ex in examples]
     for conv in conversations:
         if len(conv) < 2 or conv[1].get("role") != "assistant":

@@ -302,6 +302,119 @@ def qwen2_5_vl_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
     return m
 
 
+def phi4_mm_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    """Phi-4-multimodal, audio + text scope (no vision tower — see
+    ``models/phi4_mm.py``): Phi decoder with FUSED qkv/gate_up under
+    ``model.layers.``, conformer audio encoder under
+    ``model.embed_tokens_extend.audio_embed.``."""
+    tc = config.text_config
+    m: Dict[Tuple[str, ...], HfSpec] = {
+        ("embed_tokens", "embedding"): HfSpec("model.embed_tokens.weight"),
+        ("norm", "weight"): HfSpec("model.norm.weight"),
+        ("layers", "input_layernorm", "weight"): HfSpec(
+            "model.layers.{i}.input_layernorm.weight", stacked=True),
+        ("layers", "post_attention_layernorm", "weight"): HfSpec(
+            "model.layers.{i}.post_attention_layernorm.weight", stacked=True),
+        ("layers", "self_attn", "qkv_proj", "kernel"): HfSpec(
+            "model.layers.{i}.self_attn.qkv_proj.weight", stacked=True,
+            transpose=True),
+        ("layers", "self_attn", "o_proj", "kernel"): HfSpec(
+            "model.layers.{i}.self_attn.o_proj.weight", stacked=True,
+            transpose=True),
+        ("layers", "mlp", "gate_up_proj", "kernel"): HfSpec(
+            "model.layers.{i}.mlp.gate_up_proj.weight", stacked=True,
+            transpose=True),
+        ("layers", "mlp", "down_proj", "kernel"): HfSpec(
+            "model.layers.{i}.mlp.down_proj.weight", stacked=True,
+            transpose=True),
+    }
+    if not tc.tie_word_embeddings:
+        m[("lm_head", "kernel")] = HfSpec("lm_head.weight", transpose=True)
+    text = {("language_model",) + path: spec for path, spec in m.items()}
+
+    conv1d_load = lambda w: np.asarray(w)[:, :, 0].T     # (O, I, 1) -> (I, O)
+    conv1d_save = lambda w: np.asarray(w).T[:, :, None]
+    dw_load = lambda w: np.asarray(w)[:, 0, :]           # (C, 1, k) -> (C, k)
+    dw_save = lambda w: np.asarray(w)[:, None, :]
+    squeeze_b = lambda w: np.asarray(w).reshape(-1)      # (1, E, 1) -> (E,)
+    unsqueeze_b = lambda w: np.asarray(w)[None, :, None]
+
+    ae = "model.embed_tokens_extend.audio_embed."
+    enc = ae + "encoder."
+    blk = enc + "encoders.{i}."
+    a: Dict[Tuple[str, ...], HfSpec] = {}
+    p = ("audio_embed", "encoder")
+    a[p + ("encoder_embedding", "global_mean")] = HfSpec(
+        enc + "encoder_embedding.global_mean")
+    a[p + ("encoder_embedding", "global_invstd")] = HfSpec(
+        enc + "encoder_embedding.global_invstd")
+    a[p + ("relative_attention_bias", "weight")] = HfSpec(
+        enc + "relative_attention_bias_layer.bias_values.weight")
+    # nemo subsampling Sequential: conv0 at 0, then (dw, pw, act) triples
+    import math as _math
+
+    n_stages = int(_math.log2(config.audio_config.time_reduction))
+    conv_idx = {"conv0": 0}
+    for s in range(1, n_stages):
+        conv_idx[f"dw{s}"] = 3 * s - 1
+        conv_idx[f"pw{s}"] = 3 * s
+    for ours, idx in conv_idx.items():
+        a[p + ("embed", ours, "kernel")] = HfSpec(
+            enc + f"embed.conv.{idx}.weight")
+        a[p + ("embed", ours, "bias")] = HfSpec(
+            enc + f"embed.conv.{idx}.bias")
+    a[p + ("embed", "out", "kernel")] = HfSpec(
+        enc + "embed.out.weight", transpose=True)
+    a[p + ("embed", "out", "bias")] = HfSpec(enc + "embed.out.bias")
+
+    def lin(path, name, bias=True, conv=False):
+        if conv:
+            a[p + ("encoders",) + path + ("kernel",)] = HfSpec(
+                blk + name + ".weight", stacked=True,
+                load_transform=conv1d_load, save_transform=conv1d_save)
+        else:
+            a[p + ("encoders",) + path + ("kernel",)] = HfSpec(
+                blk + name + ".weight", stacked=True, transpose=True)
+        if bias:
+            a[p + ("encoders",) + path + ("bias",)] = HfSpec(
+                blk + name + ".bias", stacked=True)
+
+    def ln(path, name):
+        a[p + ("encoders",) + path + ("weight",)] = HfSpec(
+            blk + name + ".weight", stacked=True)
+        a[p + ("encoders",) + path + ("bias",)] = HfSpec(
+            blk + name + ".bias", stacked=True)
+
+    for mod in ("feed_forward_in", "feed_forward_out"):
+        ln((mod, "layer_norm"), mod + ".layer_norm")
+        lin((mod, "gate_up_proj"), mod + ".gate_up_proj")
+        lin((mod, "down_proj"), mod + ".down_proj")
+    ln(("layer_norm_att",), "layer_norm_att")
+    ln(("layer_norm",), "layer_norm")
+    for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+        lin(("self_attn", proj), "self_attn." + proj)
+    ln(("conv", "layer_norm"), "conv.layer_norm")
+    lin(("conv", "glu"), "conv.glu.ext_pw_conv_1d", conv=True)
+    for b in ("b1", "b2"):
+        a[p + ("encoders", "conv", f"glu_{b}")] = HfSpec(
+            blk + f"conv.glu.{b}", stacked=True,
+            load_transform=squeeze_b, save_transform=unsqueeze_b)
+    a[p + ("encoders", "conv", "dw_conv", "kernel")] = HfSpec(
+        blk + "conv.dw_sep_conv_1d.dw_conv.weight", stacked=True,
+        load_transform=dw_load, save_transform=dw_save)
+    a[p + ("encoders", "conv", "dw_conv", "bias")] = HfSpec(
+        blk + "conv.dw_sep_conv_1d.dw_conv.bias", stacked=True)
+    lin(("conv", "pw_conv"), "conv.dw_sep_conv_1d.pw_conv", conv=True)
+    lin(("conv", "ext_pw_conv"), "conv.ext_pw_conv_1d", conv=True)
+
+    for proj in ("up_proj_for_speech", "down_proj_for_speech",
+                 "up_proj_for_vision_speech", "down_proj_for_vision_speech"):
+        a[("audio_embed", proj, "kernel")] = HfSpec(
+            ae + proj + ".weight", transpose=True)
+        a[("audio_embed", proj, "bias")] = HfSpec(ae + proj + ".bias")
+    return {**text, **a}
+
+
 def _key_map_for(model) -> Dict[Tuple[str, ...], HfSpec]:
     from automodel_tpu.models.registry import get_family
 

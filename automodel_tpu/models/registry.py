@@ -106,3 +106,8 @@ def _ensure_builtin() -> None:
     register_model(ModelFamily("qwen2_5_vl_text", Qwen25VLTextConfig,
                                Qwen25VLTextModel, hf_io.llama_key_map,
                                ["Qwen2_5_VLTextModel"]))
+    from automodel_tpu.models.phi4_mm import Phi4MMConfig, Phi4MMForCausalLM
+
+    register_model(ModelFamily("phi4_multimodal", Phi4MMConfig,
+                               Phi4MMForCausalLM, hf_io.phi4_mm_key_map,
+                               ["Phi4MultimodalForCausalLM"]))

@@ -148,16 +148,18 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
         # per-row image slots ([B, I, H, W, C]).  Hosts must agree on shapes:
         # set dataloader.max_images_per_example for multi-image data.
         self._host_rows = None
-        flat_patch_family = "image_grid_thw" in getattr(
-            self.model, "extra_batch_keys", ())
-        if jax.process_count() > 1 and flat_patch_family:
-            # Qwen-style flat [n_patches, pdim] pixel streams have no
-            # per-row slot layout to assemble across hosts — stay on the
-            # global loader (every host processes the full batch)
+        # families with extra modality keys (Qwen's flat patch stream +
+        # grid metadata, Phi-4's audio clip tensors) carry batch layouts
+        # shard_batch cannot row-shard across hosts — their tensors do not
+        # map 1:1 onto dp rows, so per-host collation would desync hosts
+        flat_contract_family = bool(getattr(
+            self.model, "extra_batch_keys", ()))
+        if jax.process_count() > 1 and flat_contract_family:
             logger.warning(
-                "%s uses the flat-patch pixel contract: per-host input "
-                "sharding is disabled (global loader on every host)",
-                type(self.model).__name__)
+                "%s carries extra modality batch keys %s that have no "
+                "per-row layout: per-host input sharding is disabled "
+                "(global loader on every host)",
+                type(self.model).__name__, self.model.extra_batch_keys)
         elif jax.process_count() > 1:
             from automodel_tpu.distributed.shardings import process_batch_rows
 
