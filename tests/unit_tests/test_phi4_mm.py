@@ -212,3 +212,36 @@ def test_collator_to_train_step_integration():
     _, _, m = fns.train_step(params, opt, stacked)
     assert np.isfinite(float(m["loss"]))
     assert int(m["num_label_tokens"]) > 0
+
+
+def test_mesh_train_step_dp_tp():
+    """Phi-4-MM on a dp4 x tp2 mesh: the audio encoder's and fused decoder's
+    param_axes compose with the parallel plan (audio tensors replicate,
+    decoder shards)."""
+    from automodel_tpu.distributed.mesh import MeshManager
+    from automodel_tpu.distributed.shardings import build_parallel_plan
+    from automodel_tpu.optim import build_optimizer
+    from automodel_tpu.training.train_step import build_train_step
+
+    model = _model()
+    mm = MeshManager(dp_size=4, tp_size=2)
+    plan = build_parallel_plan(model, mm)
+    fns = build_train_step(model, build_optimizer(name="adamw", lr=5e-3),
+                           plan=plan)
+    params = plan.shard_params(model.init(jax.random.key(6)))
+    opt = fns.init_opt_state(params)
+    rng = np.random.default_rng(6)
+    ids, feats, sizes = _audio_batch(rng)
+    ids = np.broadcast_to(ids, (4, ids.shape[1])).copy()
+    labels = np.roll(ids, -1, -1)
+    labels[:, -1] = -100
+    feats = np.broadcast_to(feats, (4,) + feats.shape[1:]).copy()
+    sizes = np.broadcast_to(sizes, (4,)).copy()
+    batch = fns.shard_batch({
+        "input_ids": ids[None].astype(np.int32),
+        "labels": labels[None].astype(np.int32),
+        "input_audio_embeds": feats[None],
+        "audio_embed_sizes": sizes[None].astype(np.int32),
+    })
+    _, _, m = fns.train_step(params, opt, batch)
+    assert np.isfinite(float(m["loss"]))

@@ -209,3 +209,36 @@ def test_window_partition_with_padding_matches_transformers(tmp_path):
                  position_ids=jnp.asarray(pos))["logits"]
     np.testing.assert_allclose(np.asarray(ours, np.float32), ref,
                                atol=3e-4, rtol=3e-3)
+
+
+def test_temporal_grid_parity(tmp_path):
+    """t > 1 grids (the video-style temporal axis): rot-pos tables tile over
+    t and the window partition spans frames — pinned against HF."""
+    grid = (2, 4, 4)
+    model = Qwen25VLForConditionalGeneration(
+        Qwen25VLConfig.from_hf_config(dict(TINY)),
+        param_dtype=jnp.float32, compute_dtype=jnp.float32,
+        remat=False, image_grid=grid)
+    params = _randomized(model, jax.random.key(6))
+    hf = _export(model, params, tmp_path)
+    rng = np.random.default_rng(6)
+    t, h, w = grid
+    n_units = t * (h // 2) * (w // 2)
+    ids = np.asarray(
+        [rng.integers(1, 90, 3).tolist() + [VSTART] + [IMG] * n_units
+         + rng.integers(1, 90, 4).tolist()], np.int64)
+    patches = rng.normal(size=(t * h * w, 3 * 2 * 4 * 4)).astype(np.float32)
+    hf_grid = np.asarray([[t, h, w]], np.int64)
+    with torch.no_grad():
+        ref = hf(input_ids=torch.from_numpy(ids),
+                 pixel_values=torch.from_numpy(patches),
+                 image_grid_thw=torch.from_numpy(hf_grid)).logits.numpy()
+    pos = qwen_mrope_position_ids(
+        ids, hf_grid, None, spatial_merge_size=2, image_token_id=IMG,
+        video_token_id=VID, vision_start_token_id=VSTART)
+    ours = model(params, jnp.asarray(ids, jnp.int32),
+                 pixel_values=jnp.asarray(patches),
+                 image_grid_thw=jnp.asarray(hf_grid, jnp.int32),
+                 position_ids=jnp.asarray(pos))["logits"]
+    np.testing.assert_allclose(np.asarray(ours, np.float32), ref,
+                               atol=3e-4, rtol=3e-3)
