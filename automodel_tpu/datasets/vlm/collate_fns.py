@@ -103,22 +103,27 @@ def _shifted_masked_labels(input_ids: np.ndarray,
     return labels
 
 
-def _gather_images(examples: List[dict]) -> Optional[List[Any]]:
-    """Per-example image lists, from the ``images`` key or from image entries
-    embedded in conversation content."""
+def _gather_media(examples: List[dict], list_key: str,
+                  item_key: str) -> Optional[List[Any]]:
+    """Per-example media lists, from the top-level ``list_key`` or from
+    ``item_key`` entries embedded in conversation content."""
     out: List[Any] = []
     found = False
     for ex in examples:
-        imgs = list(ex.get("images") or [])
-        if not imgs:
+        items = list(ex.get(list_key) or [])
+        if not items:
             for turn in ex.get("conversation", []):
                 content = turn.get("content")
                 if isinstance(content, list):
-                    imgs.extend(c["image"] for c in content
-                                if isinstance(c, dict) and "image" in c)
-        found = found or bool(imgs)
-        out.append(imgs)
+                    items.extend(c[item_key] for c in content
+                                 if isinstance(c, dict) and item_key in c)
+        found = found or bool(items)
+        out.append(items)
     return out if found else None
+
+
+def _gather_images(examples: List[dict]) -> Optional[List[Any]]:
+    return _gather_media(examples, "images", "image")
 
 
 def _row_image_slots(flat: np.ndarray, counts: List[int],
@@ -207,6 +212,10 @@ def _collate(examples: List[dict], processor,
     return out
 
 
+def _gather_videos(examples: List[dict]) -> Optional[List[Any]]:
+    return _gather_media(examples, "videos", "video")
+
+
 def _qwen_special(processor) -> Dict[str, int]:
     """Special-token ids + merge size off a (real or mock) Qwen processor."""
     tokenizer = getattr(processor, "tokenizer", processor)
@@ -226,7 +235,8 @@ def _qwen_special(processor) -> Dict[str, int]:
 def qwen2_5_collate_fn(examples: List[dict], processor,
                        start_of_response_token: str = "<|im_start|>assistant\n",
                        pad_seq_len_divisible: Optional[int] = None,
-                       fixed_length: Optional[int] = None
+                       fixed_length: Optional[int] = None,
+                       tokens_per_second: int = 2
                        ) -> Dict[str, np.ndarray]:
     """Qwen2.5-VL: im_start/assistant response marker (reference
     ``collate_fns.py:120-148``).
@@ -248,18 +258,31 @@ def qwen2_5_collate_fn(examples: List[dict], processor,
     images = _gather_images(examples)
     if images is not None:
         kwargs["images"] = images
+    videos = _gather_videos(examples)
+    if videos is not None:
+        kwargs["videos"] = videos
     batch = processor(text=texts, **kwargs)
 
     input_ids = _as_numpy(batch["input_ids"]).astype(np.int32)
     attn = (None if batch.get("attention_mask") is None
             else _as_numpy(batch["attention_mask"]).astype(np.int32))
     out: Dict[str, np.ndarray] = {"input_ids": input_ids}
-    grid = None
+    grid = vgrid = spg = None
     if batch.get("pixel_values") is not None:
         out["pixel_values"] = _as_numpy(batch["pixel_values"]).astype(
             np.float32)
         grid = _as_numpy(batch["image_grid_thw"]).astype(np.int32)
         out["image_grid_thw"] = grid
+    if batch.get("pixel_values_videos") is not None:
+        out["pixel_values_videos"] = _as_numpy(
+            batch["pixel_values_videos"]).astype(np.float32)
+        vgrid = _as_numpy(batch["video_grid_thw"]).astype(np.int32)
+        out["video_grid_thw"] = vgrid
+        if batch.get("second_per_grid_ts") is not None:
+            # consumed host-side by the rope-index walk only (scales the
+            # temporal axis); never enters the device batch
+            spg = np.asarray(
+                _as_numpy(batch["second_per_grid_ts"]), np.float64)
 
     loss_masks = [
         create_loss_mask_with_start_of_response_token(
@@ -270,22 +293,26 @@ def qwen2_5_collate_fn(examples: List[dict], processor,
         input_ids, extract_skipped_token_ids(processor), loss_masks)
     out["loss_mask"] = np.asarray(loss_masks, np.float32)
     sp = _qwen_special(processor)
-    if grid is not None:
-        # a truncated image span (fixed_length shorter than the expanded
+    for g, tok_key, name in ((grid, "image_token_id", "image"),
+                             (vgrid, "video_token_id", "video")):
+        if g is None:
+            continue
+        # a truncated vision span (fixed_length shorter than the expanded
         # placeholders) would both crash the rope-index walk and misalign
         # the feature scatter — fail with the cause, not a shape error
         m = sp["spatial_merge_size"]
         expect = int(sum(int(t) * (int(h) // m) * (int(w) // m)
-                         for t, h, w in grid))
-        got = int((input_ids == sp["image_token_id"]).sum())
+                         for t, h, w in g))
+        got = int((input_ids == sp[tok_key]).sum())
         if got != expect:
             raise ValueError(
-                f"batch carries {got} image placeholder tokens but "
-                f"image_grid_thw implies {expect} — an image span was "
+                f"batch carries {got} {name} placeholder tokens but "
+                f"{name}_grid_thw implies {expect} — a {name} span was "
                 "truncated (raise fixed_length / max_length) or the "
                 "processor's placeholder expansion disagrees with the grid")
     out["position_ids"] = qwen_mrope_position_ids(
-        input_ids, grid, attn, **sp)
+        input_ids, grid, attn, video_grid_thw=vgrid,
+        second_per_grid_ts=spg, tokens_per_second=tokens_per_second, **sp)
     if pad_seq_len_divisible:
         pad = (-input_ids.shape[1]) % int(pad_seq_len_divisible)
         _pad_text_fields(out, processor, int(pad_seq_len_divisible))

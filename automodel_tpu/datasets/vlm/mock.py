@@ -166,19 +166,25 @@ class Qwen2_5_VLProcessor:
 
     def __init__(self, vocab_size: int = 256, grid=(1, 4, 4),
                  patch_size: int = 4, temporal_patch_size: int = 2,
-                 merge_size: int = 2, num_channels: int = 3):
+                 merge_size: int = 2, num_channels: int = 3,
+                 video_grid=(2, 4, 4), second_per_grid_t: float = 1.0):
         self.grid = tuple(grid)
+        self.video_grid = tuple(video_grid)
+        self.second_per_grid_t = float(second_per_grid_t)
         self.patch_size = patch_size
         self.temporal_patch_size = temporal_patch_size
         self.merge_size = merge_size
         self.num_channels = num_channels
         t, h, w = self.grid
         self.n_units = t * (h // merge_size) * (w // merge_size)
+        vt, vh, vw = self.video_grid
+        self.n_video_units = vt * (vh // merge_size) * (vw // merge_size)
         self.image_size = (h * patch_size, w * patch_size)
         self.tokenizer = _MockTokenizer(vocab_size, image_token_id=0)
         self.tokenizer._special.update({
             "<|vision_start|>": 5, "<|image_pad|>": 6, "<|vision_end|>": 7,
             "<|im_start|>": 8, "<|im_end|>": 9, "assistant": 10, "user": 11,
+            "<|video_pad|>": 12,
         })
         self.image_processor = self           # exposes .merge_size
 
@@ -196,15 +202,21 @@ class Qwen2_5_VLProcessor:
                         parts += (["<|vision_start|>"]
                                   + ["<|image_pad|>"] * self.n_units
                                   + ["<|vision_end|>"])
+                    elif c.get("type") == "video":
+                        parts += (["<|vision_start|>"]
+                                  + ["<|video_pad|>"] * self.n_video_units
+                                  + ["<|vision_end|>"])
                     elif c.get("type") == "text":
                         parts.append(c["text"])
             parts.append("<|im_end|>")
         text = " ".join(parts)
         return self.tokenizer(text)["input_ids"] if tokenize else text
 
-    def _patchify(self, img) -> np.ndarray:
-        t, h, w = self.grid
+    def _patchify(self, img, grid=None) -> np.ndarray:
+        t, h, w = grid or self.grid
         ps, tps, C = self.patch_size, self.temporal_patch_size, self.num_channels
+        if np.asarray(img).ndim == 4:      # video [frames, H, W, C]: frame 0
+            img = np.asarray(img)[0]
         arr = np.asarray(img, np.float32)
         if arr.ndim == 2:
             arr = np.stack([arr] * C, axis=-1)
@@ -221,8 +233,9 @@ class Qwen2_5_VLProcessor:
         p = np.tile(p.reshape(h * w, -1), (t, 1))
         return p.astype(np.float32)                   # [t*h*w, C*tps*ps*ps]
 
-    def __call__(self, text, images=None, padding=True, return_tensors="np",
-                 truncation=False, max_length=None, **_kw):
+    def __call__(self, text, images=None, videos=None, padding=True,
+                 return_tensors="np", truncation=False, max_length=None,
+                 **_kw):
         seqs = [self.tokenizer(t)["input_ids"] for t in text]
         if truncation and max_length:
             seqs = [s[:max_length] for s in seqs]
@@ -243,7 +256,44 @@ class Qwen2_5_VLProcessor:
                 batch["pixel_values"] = np.concatenate(flat, axis=0)
                 batch["image_grid_thw"] = np.asarray(
                     [list(self.grid)] * len(flat), np.int64)
+        if videos is not None:
+            flat = [self._patchify(v, self.video_grid)
+                    for vids in videos for v in vids]
+            if flat:
+                batch["pixel_values_videos"] = np.concatenate(flat, axis=0)
+                batch["video_grid_thw"] = np.asarray(
+                    [list(self.video_grid)] * len(flat), np.int64)
+                batch["second_per_grid_ts"] = np.asarray(
+                    [self.second_per_grid_t] * len(flat), np.float64)
         return batch
+
+
+def make_mock_video_dataset(num_samples: int = 32, image_size: int = 16,
+                            num_frames: int = 4, seed: int = 0,
+                            limit_dataset_samples: Optional[int] = None,
+                            **_kw) -> List[dict]:
+    """Synthetic video->description conversations (qwen video path: the
+    collator routes these through ``pixel_values_videos`` +
+    ``video_grid_thw`` + ``second_per_grid_ts``)."""
+    rng = np.random.default_rng(seed)
+    n = min(num_samples, limit_dataset_samples or num_samples)
+    words = ["walk", "run", "jump", "spin", "fall", "rise", "wave"]
+    out = []
+    for _ in range(n):
+        vid = rng.integers(
+            0, 256, (num_frames, image_size, image_size, 3)).astype(np.uint8)
+        desc = " ".join(rng.choice(words, size=5))
+        out.append({
+            "conversation": [
+                {"role": "user", "content": [
+                    {"type": "video"},
+                    {"type": "text", "text": "Describe this video."}]},
+                {"role": "assistant", "content": [
+                    {"type": "text", "text": desc}]},
+            ],
+            "videos": [vid],
+        })
+    return out
 
 
 class Phi4MMProcessor:

@@ -73,8 +73,13 @@ def qwen_mrope_position_ids(
             base = pieces[-1].max() + 1 if pieces else 0
             pieces.append(np.broadcast_to(
                 np.arange(text_len) + base, (3, text_len)).copy())
+            # HF casts second_per_grid_t to the (integer) dtype of its
+            # arange before the multiply (modeling_qwen2_5_vl
+            # ``torch.as_tensor(second_per_grid_t, dtype=range_tensor.
+            # dtype)``), so fractional intervals truncate toward zero —
+            # matched here for index parity
             t_idx = (np.arange(t)[:, None]
-                     * per_t * tokens_per_second).astype(np.int64)
+                     * np.int64(per_t) * tokens_per_second).astype(np.int64)
             t_idx = np.broadcast_to(t_idx, (t, gh * gw)).reshape(-1)
             h_idx = np.broadcast_to(
                 np.arange(gh)[None, :, None], (t, gh, gw)).reshape(-1)

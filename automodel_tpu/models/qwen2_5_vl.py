@@ -314,6 +314,7 @@ class Qwen25VisionTower:
         scatter = jnp.asarray(lay["scatter"])             # [L]
         nW, wlen_p = gather.shape
         Hh, Dh = cfg.num_heads, cfg.head_dim
+        t_frames, frame_p = grid[0], L // grid[0]
 
         x = patches.astype(cd) @ params["patch_embed"]["kernel"].astype(cd)
 
@@ -331,8 +332,15 @@ class Qwen25VisionTower:
             k = _rot_half(k, cos, sin)
 
             def full_attn(args):
+                # "Full" attention is per temporal frame (HF builds
+                # cu_seqlens = repeat_interleave(h*w, t)); canonical order
+                # is t-major so frames are contiguous.
                 q, k, v = args
-                return attention(q, k, v, causal=False)
+                def per_frame(z):
+                    return z.reshape(N * t_frames, frame_p, Hh, Dh)
+                out = attention(per_frame(q), per_frame(k), per_frame(v),
+                                causal=False)
+                return out.reshape(N, L, Hh, Dh)
 
             def window_attn(args):
                 q, k, v = args
@@ -419,22 +427,27 @@ class Qwen25VLTextModel(LlamaForCausalLM):
 class Qwen25VLForConditionalGeneration:
     """``model._target_: automodel_tpu.models.qwen2_5_vl.build_qwen25_vl``
 
-    ``image_grid``: the STATIC per-image patch grid (t, h, w) this program
-    is compiled for (dynamic resolution = one compile per distinct grid;
-    batches group by grid at the collator).  ``image_grid_thw`` batch data
-    is accepted for HF-contract parity and checked against it.
+    ``image_grid`` / ``video_grid``: the STATIC per-image / per-video patch
+    grids (t, h, w) this program is compiled for (dynamic resolution = one
+    compile per distinct grid; batches group by grid at the collator).
+    ``image_grid_thw`` / ``video_grid_thw`` batch data are accepted for
+    HF-contract parity; the VLM recipe validates them host-side against the
+    static grids (``recipes/vlm/finetune.py:_device_batch``), and
+    ``encode_images`` asserts patch-count divisibility at trace time.
     """
 
-    extra_batch_keys = ("image_grid_thw",)
+    extra_batch_keys = ("image_grid_thw", "pixel_values_videos",
+                        "video_grid_thw")
 
     def __init__(self, config: Qwen25VLConfig,
                  param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
                  remat: bool = True, image_grid: Optional[Tuple] = None,
-                 **kwargs):
+                 video_grid: Optional[Tuple] = None, **kwargs):
         self.config = config
         self.param_dtype = jnp.dtype(param_dtype)
         self.compute_dtype = jnp.dtype(compute_dtype)
         self.image_grid = tuple(image_grid) if image_grid else None
+        self.video_grid = tuple(video_grid) if video_grid else None
         self.language_model = Qwen25VLTextModel(
             config.text_config, mrope_section=config.mrope_section,
             param_dtype=param_dtype, compute_dtype=compute_dtype,
@@ -464,13 +477,30 @@ class Qwen25VLForConditionalGeneration:
         [n_images * n_units, out_hidden] (placeholder-scatter order)."""
         t, h, w = grid
         L = t * h * w
+        if pixel_values.shape[0] % L != 0:
+            raise ValueError(
+                f"pixel patch count {pixel_values.shape[0]} does not divide "
+                f"the static grid {grid} ({L} patches per item): the batch "
+                "was produced for a different resolution — group batches by "
+                "grid at the collator or set model.image_grid/video_grid to "
+                "match the processor's output")
         n = pixel_values.shape[0] // L
         feats = self.visual(params["visual"],
                             pixel_values.reshape(n, L, -1), grid)
         return feats.reshape(n * feats.shape[1], feats.shape[2])
 
+    def _scatter_modality(self, embeds, input_ids, feats, token_id):
+        """Scatter merged vision features onto their placeholder tokens."""
+        B, S = input_ids.shape
+        is_tok = (input_ids == token_id).reshape(-1)
+        idx = jnp.clip(jnp.cumsum(is_tok) - 1, 0, feats.shape[0] - 1)
+        gathered = feats[idx].reshape(B, S, -1)
+        return jnp.where(is_tok.reshape(B, S)[..., None],
+                         gathered.astype(embeds.dtype), embeds)
+
     def __call__(self, params, input_ids, pixel_values=None,
-                 image_grid_thw=None, position_ids=None, segment_ids=None,
+                 image_grid_thw=None, pixel_values_videos=None,
+                 video_grid_thw=None, position_ids=None, segment_ids=None,
                  attention_mask=None, return_hidden: bool = False,
                  kv_cache=None, cache_index=None) -> Dict[str, jnp.ndarray]:
         lm = self.language_model
@@ -479,18 +509,24 @@ class Qwen25VLForConditionalGeneration:
         embeds = lp["embed_tokens"]["embedding"][input_ids].astype(
             self.compute_dtype)
         if pixel_values is not None:
-            grid = self.image_grid
-            if grid is None:
+            if self.image_grid is None:
                 raise ValueError(
                     "Qwen2.5-VL needs a static image_grid=(t, h, w): set "
                     "model.image_grid (the jitted program is compiled per "
                     "grid; image_grid_thw arrays are data, not shapes)")
-            img_flat = self.encode_images(params, pixel_values, grid)
-            is_img = (input_ids == self.config.image_token_id).reshape(-1)
-            idx = jnp.clip(jnp.cumsum(is_img) - 1, 0, img_flat.shape[0] - 1)
-            gathered = img_flat[idx].reshape(B, S, -1)
-            embeds = jnp.where(is_img.reshape(B, S)[..., None],
-                               gathered.astype(embeds.dtype), embeds)
+            img_flat = self.encode_images(params, pixel_values,
+                                          self.image_grid)
+            embeds = self._scatter_modality(
+                embeds, input_ids, img_flat, self.config.image_token_id)
+        if pixel_values_videos is not None:
+            if self.video_grid is None:
+                raise ValueError(
+                    "Qwen2.5-VL needs a static video_grid=(t, h, w) to "
+                    "consume pixel_values_videos: set model.video_grid")
+            vid_flat = self.encode_images(params, pixel_values_videos,
+                                          self.video_grid)
+            embeds = self._scatter_modality(
+                embeds, input_ids, vid_flat, self.config.video_token_id)
         if position_ids is not None and position_ids.ndim == 3 \
                 and position_ids.shape[-1] != 3:
             raise ValueError("M-RoPE position_ids must be [B, S, 3]")

@@ -123,6 +123,37 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
     # YAML ``max_grad_norm: null`` disables.
     _default_max_grad_norm = 1.0
 
+    def _device_batch(self, batches, train: bool = True,
+                      process_local=None):
+        """Host-side grid validation before device placement: a batch whose
+        grid_thw disagrees with the model's compiled-in static grid would
+        otherwise either fail an opaque reshape or — when the patch count
+        happens to divide — silently run with wrong rope tables and window
+        partition."""
+        for key, static in (("image_grid_thw",
+                             getattr(self.model, "image_grid", None)),
+                            ("video_grid_thw",
+                             getattr(self.model, "video_grid", None))):
+            if static is None:
+                continue
+            for mb in batches:
+                g = mb.get(key)
+                if g is None:
+                    continue
+                import numpy as np
+
+                rows = np.asarray(g)
+                real = rows[np.any(rows != 0, axis=-1)]  # zero rows = padding
+                if real.size and not np.all(real == np.asarray(static)):
+                    raise ValueError(
+                        f"{key} rows {real.tolist()} do not match the "
+                        f"model's static grid {tuple(static)} — the jitted "
+                        "program is compiled per grid; group batches by "
+                        "grid at the collator or set the model's "
+                        f"{key.replace('_thw', '')} to match")
+        return super()._device_batch(batches, train=train,
+                                     process_local=process_local)
+
     def _build_freeze_mask(self):
         """``freeze_config`` YAML, defaulting to frozen embeddings when the
         section is absent (reference ``_freeze_model``,

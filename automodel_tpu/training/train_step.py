@@ -129,8 +129,8 @@ class TrainStepFns:
             return size
 
         def place(key, v):
-            if key == "image_grid_thw":
-                # [A, N, 3] image-grid metadata: host-invariant, replicated
+            if key in ("image_grid_thw", "video_grid_thw"):
+                # [A, N, 3] grid metadata: host-invariant, replicated
                 return jax.device_put(v, rep)
             if key == "position_ids" and getattr(v, "ndim", 0) == 4:
                 # M-RoPE ids [A, B, S, 3]: batch/seq shard like the tokens
@@ -139,7 +139,7 @@ class TrainStepFns:
                     return jax.make_array_from_process_local_data(
                         sh, np.asarray(v))
                 return jax.device_put(v, sh)
-            if key == "pixel_values":
+            if key in ("pixel_values", "pixel_values_videos"):
                 ndim = getattr(v, "ndim", 0)
                 if ndim == 6:
                     # [A, B, I, H, W, C]: rows shard exactly like the token
@@ -348,7 +348,7 @@ def stack_microbatches(microbatches) -> Dict[str, jnp.ndarray]:
     out = {}
     for k in sorted(keys):
         arrs = [np.asarray(mb[k]) for mb in microbatches]
-        if k == "pixel_values":
+        if k in ("pixel_values", "pixel_values_videos"):
             # Image counts vary per microbatch.  Per-row slot layout
             # [B, I, ...]: pad the slot dim I; legacy flat [B_img, ...]: pad
             # the image list.  Trailing pads are never referenced (each
@@ -367,10 +367,17 @@ def stack_microbatches(microbatches) -> Dict[str, jnp.ndarray]:
                            [(0, max_imgs - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
                     for a in arrs
                 ]
-        elif k == "image_grid_thw":
+        elif k in ("image_grid_thw", "video_grid_thw"):
             # image counts vary per microbatch: zero-pad the image dim
             max_n = max(a.shape[0] for a in arrs)
             arrs = [np.pad(a, [(0, max_n - a.shape[0]), (0, 0)])
+                    for a in arrs]
+        elif k == "input_audio_embeds":
+            # [B, T, input_size]: the varying dim is T (longest clip per
+            # microbatch), not the trailing feature dim — zero-pad frames
+            # (audio_attention_mask is [B, T], covered by last-dim padding)
+            max_t = max(a.shape[1] for a in arrs)
+            arrs = [np.pad(a, [(0, 0), (0, max_t - a.shape[1]), (0, 0)])
                     for a in arrs]
         elif k == "position_ids" and arrs[0].ndim == 3:
             # M-RoPE ids [B, S, 3]: the padded dim is S, not the trailing

@@ -149,6 +149,24 @@ def test_qwen25_vl_recipe_trains(tmp_path):
     assert np.isfinite(r2.last_metrics["loss"])
 
 
+def test_qwen25_vl_video_recipe_trains(tmp_path):
+    """Qwen2.5-VL VIDEO path end-to-end: the qwen collator routes
+    pixel_values_videos + video_grid_thw + second_per_grid_ts (fractional,
+    exercising the HF integer-truncation quirk) through the recipe; loss
+    descends."""
+    from automodel_tpu.recipes.vlm.finetune import FinetuneRecipeForVLM
+
+    yaml = os.path.join(os.path.dirname(__file__), "..", "..", "examples",
+                        "vlm_finetune", "tiny_qwen25_vl_video_mock.yaml")
+    cfg = parse_args_and_load_config(["--config", yaml])
+    recipe = FinetuneRecipeForVLM(cfg).setup()
+    first = recipe._run_train_optim_step(next(iter(recipe.step_scheduler)))
+    recipe.run_train_validation_loop()
+    assert recipe.step_scheduler.step == 4
+    assert np.isfinite(recipe.last_metrics["loss"])
+    assert recipe.last_metrics["loss"] < first["loss"]
+
+
 def test_phi4_mm_recipe_trains(tmp_path):
     """Phi-4-MM audio end-to-end through the VLM recipe: the COLLATE_FNS
     dispatch routes the Phi4MMProcessor to the phi4 collator, whose audio

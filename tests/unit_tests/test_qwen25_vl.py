@@ -211,6 +211,44 @@ def test_window_partition_with_padding_matches_transformers(tmp_path):
                                atol=3e-4, rtol=3e-3)
 
 
+def test_video_path_parity(tmp_path):
+    """Videos: pixel_values_videos + video_grid_thw + second_per_grid_ts —
+    the temporal rope axis scales by tokens_per_second * second_per_grid_t
+    and features scatter onto video placeholder tokens."""
+    vgrid = (2, 4, 4)
+    model = Qwen25VLForConditionalGeneration(
+        Qwen25VLConfig.from_hf_config(dict(TINY)),
+        param_dtype=jnp.float32, compute_dtype=jnp.float32,
+        remat=False, video_grid=vgrid)
+    params = _randomized(model, jax.random.key(8))
+    hf = _export(model, params, tmp_path)
+    rng = np.random.default_rng(8)
+    t, h, w = vgrid
+    n_units = t * (h // 2) * (w // 2)
+    ids = np.asarray(
+        [rng.integers(1, 90, 3).tolist() + [VSTART] + [VID] * n_units
+         + rng.integers(1, 90, 4).tolist()], np.int64)
+    patches = rng.normal(size=(t * h * w, 3 * 2 * 4 * 4)).astype(np.float32)
+    hf_grid = np.asarray([[t, h, w]], np.int64)
+    spg = np.asarray([0.5], np.float64)
+    with torch.no_grad():
+        ref = hf(input_ids=torch.from_numpy(ids),
+                 pixel_values_videos=torch.from_numpy(patches),
+                 video_grid_thw=torch.from_numpy(hf_grid),
+                 second_per_grid_ts=torch.from_numpy(spg)).logits.numpy()
+    pos = qwen_mrope_position_ids(
+        ids, None, None, spatial_merge_size=2, image_token_id=IMG,
+        video_token_id=VID, vision_start_token_id=VSTART,
+        video_grid_thw=hf_grid, second_per_grid_ts=spg,
+        tokens_per_second=TINY["vision_config"]["tokens_per_second"])
+    ours = model(params, jnp.asarray(ids, jnp.int32),
+                 pixel_values_videos=jnp.asarray(patches),
+                 video_grid_thw=jnp.asarray(hf_grid, jnp.int32),
+                 position_ids=jnp.asarray(pos))["logits"]
+    np.testing.assert_allclose(np.asarray(ours, np.float32), ref,
+                               atol=3e-4, rtol=3e-3)
+
+
 def test_temporal_grid_parity(tmp_path):
     """t > 1 grids (the video-style temporal axis): rot-pos tables tile over
     t and the window partition spans frames — pinned against HF."""
