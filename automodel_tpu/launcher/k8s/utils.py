@@ -52,87 +52,84 @@ def render_manifest(k8s: K8sConfig, command: str,
                     config_yaml: Optional[str] = None) -> str:
     """ConfigMap (the recipe YAML, mounted read-only — pods have no shared
     filesystem with the submit host) + headless Service + indexed batch Job,
-    one pod per slice host."""
+    one pod per slice host.
+
+    Rendered from dict structures via ``yaml.safe_dump`` so env values, the
+    shell command, and embedded config content are always correctly escaped
+    (raw f-string interpolation broke on quotes/colons/newlines)."""
+    import yaml
+
     coord = f"{k8s.job_name}-0.{k8s.job_name}"
-    env_lines = [
-        ("JAX_COORDINATOR_ADDRESS", f"{coord}:{k8s.coordinator_port}"),
-        ("JAX_NUM_PROCESSES", str(k8s.num_hosts)),
-    ] + sorted((k8s.env_vars or {}).items())
-    env_yaml = "\n".join(
-        f"""            - name: {k}
-              value: "{v}\"""" for k, v in env_lines)
-    config_doc = ""
+    env = [{"name": "JAX_PROCESS_ID", "valueFrom": {"fieldRef": {
+        "fieldPath": ("metadata.annotations"
+                      "['batch.kubernetes.io/job-completion-index']")}}}]
+    env += [{"name": k, "value": str(v)} for k, v in (
+        [("JAX_COORDINATOR_ADDRESS", f"{coord}:{k8s.coordinator_port}"),
+         ("JAX_NUM_PROCESSES", str(k8s.num_hosts))]
+        + sorted((k8s.env_vars or {}).items()))]
+    docs = []
     if config_yaml is not None:
-        indented = "\n".join("    " + line
-                             for line in config_yaml.splitlines())
-        config_doc = f"""apiVersion: v1
-kind: ConfigMap
-metadata:
-  name: {k8s.job_name}-config
-  namespace: {k8s.namespace}
-data:
-  config.yaml: |
-{indented}
----
-"""
-    return config_doc + f"""apiVersion: v1
-kind: Service
-metadata:
-  name: {k8s.job_name}
-  namespace: {k8s.namespace}
-spec:
-  clusterIP: None
-  selector:
-    job-name: {k8s.job_name}
----
-apiVersion: batch/v1
-kind: Job
-metadata:
-  name: {k8s.job_name}
-  namespace: {k8s.namespace}
-spec:
-  completions: {k8s.num_hosts}
-  parallelism: {k8s.num_hosts}
-  completionMode: Indexed
-  backoffLimit: 0
-  template:
-    metadata:
-      labels:
-        job-name: {k8s.job_name}
-    spec:
-      subdomain: {k8s.job_name}
-      restartPolicy: Never
-      nodeSelector:
-        cloud.google.com/gke-tpu-accelerator: {k8s.tpu_accelerator}
-        cloud.google.com/gke-tpu-topology: {k8s.tpu_topology}
-      containers:
-        - name: automodel
-          image: {k8s.image}
-          workingDir: {k8s.workdir}
-          command: ["/bin/sh", "-c"]
-          args: ["{command}"]
-          env:
-            - name: JAX_PROCESS_ID
-              valueFrom:
-                fieldRef:
-                  fieldPath: metadata.annotations['batch.kubernetes.io/job-completion-index']
-{env_yaml}
-          ports:
-            - containerPort: {k8s.coordinator_port}
-          volumeMounts:
-            - name: config
-              mountPath: /etc/automodel
-              readOnly: true
-          resources:
-            requests:
-              google.com/tpu: {k8s.chips_per_host}
-            limits:
-              google.com/tpu: {k8s.chips_per_host}
-      volumes:
-        - name: config
-          configMap:
-            name: {k8s.job_name}-config
-"""
+        docs.append({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": f"{k8s.job_name}-config",
+                         "namespace": k8s.namespace},
+            "data": {"config.yaml": config_yaml},
+        })
+    docs.append({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": k8s.job_name, "namespace": k8s.namespace},
+        "spec": {"clusterIP": None,
+                 "selector": {"job-name": k8s.job_name}},
+    })
+    docs.append({
+        "apiVersion": "batch/v1", "kind": "Job",
+        "metadata": {"name": k8s.job_name, "namespace": k8s.namespace},
+        "spec": {
+            "completions": k8s.num_hosts,
+            "parallelism": k8s.num_hosts,
+            "completionMode": "Indexed",
+            "backoffLimit": 0,
+            "template": {
+                "metadata": {"labels": {"job-name": k8s.job_name}},
+                "spec": {
+                    "subdomain": k8s.job_name,
+                    "restartPolicy": "Never",
+                    "nodeSelector": {
+                        "cloud.google.com/gke-tpu-accelerator":
+                            k8s.tpu_accelerator,
+                        "cloud.google.com/gke-tpu-topology":
+                            k8s.tpu_topology,
+                    },
+                    "containers": [{
+                        "name": "automodel",
+                        "image": k8s.image,
+                        "workingDir": k8s.workdir,
+                        "command": ["/bin/sh", "-c"],
+                        "args": [command],
+                        "env": env,
+                        "ports": [
+                            {"containerPort": k8s.coordinator_port}],
+                        "volumeMounts": [{
+                            "name": "config",
+                            "mountPath": "/etc/automodel",
+                            "readOnly": True}],
+                        "resources": {
+                            "requests": {
+                                "google.com/tpu": k8s.chips_per_host},
+                            "limits": {
+                                "google.com/tpu": k8s.chips_per_host}},
+                    }],
+                    "volumes": [{
+                        "name": "config",
+                        "configMap": {
+                            "name": f"{k8s.job_name}-config"}}],
+                },
+            },
+        },
+    })
+    return "---\n".join(
+        yaml.safe_dump(d, sort_keys=False, default_flow_style=False)
+        for d in docs)
 
 
 def submit_k8s_job(cfg, command: str, domain: str, config_path: str,

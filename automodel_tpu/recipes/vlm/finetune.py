@@ -53,12 +53,22 @@ def build_processor(cfg: ConfigNode, model) -> Any:
         "offline runs) or point `model` at a checkpoint with processor files")
 
 
-def select_collate_fn(dl_cfg: Optional[ConfigNode], processor) -> Callable:
+def select_collate_fn(dl_cfg: Optional[ConfigNode], processor,
+                      model=None) -> Callable:
     """Resolve the collator: an explicit ``dataloader.collate_fn`` node wins;
     otherwise dispatch on the processor class name through ``COLLATE_FNS``
     (reference ``vlm/finetune.py`` collate wiring +
-    ``datasets/vlm/collate_fns.py:187-190``)."""
+    ``datasets/vlm/collate_fns.py:187-190``).
+
+    ``model``: collator knobs that must AGREE with the model config
+    (qwen's ``tokens_per_second`` scales the temporal rope axis) default to
+    the model's value instead of the collator's own default — a divergence
+    would silently train with wrong position ids."""
     from automodel_tpu.recipes.llm.train_ft import _accepts_kwarg
+
+    model_tps = getattr(
+        getattr(getattr(model, "config", None), "vision_config", None),
+        "tokens_per_second", None)
 
     def bind(fn, call):
         """Forward loader kwargs (pad_seq_len_divisible, ...) only when the
@@ -73,8 +83,15 @@ def select_collate_fn(dl_cfg: Optional[ConfigNode], processor) -> Callable:
         from automodel_tpu.config.loader import resolve_target
 
         target = resolve_target(node.get("_target_"))
-        return bind(target, lambda examples, kw: node.instantiate(
-            examples=examples, processor=processor, **kw))
+
+        def call(examples, kw):
+            if (model_tps is not None and "tokens_per_second" not in node
+                    and _accepts_kwarg(target, "tokens_per_second")):
+                kw.setdefault("tokens_per_second", int(model_tps))
+            return node.instantiate(
+                examples=examples, processor=processor, **kw)
+
+        return bind(target, call)
     if callable(node):
         return bind(node, lambda examples, kw: node(
             examples, processor=processor, **kw))
@@ -90,12 +107,14 @@ def select_collate_fn(dl_cfg: Optional[ConfigNode], processor) -> Callable:
         v = dl_cfg.get(knob) if isinstance(dl_cfg, ConfigNode) else None
         if v is not None and _accepts_kwarg(fn, knob):
             extra[knob] = int(v)
+    if model_tps is not None and _accepts_kwarg(fn, "tokens_per_second"):
+        extra["tokens_per_second"] = int(model_tps)
     return functools.partial(fn, processor=processor, **extra)
 
 
 def build_vlm_dataloader(cfg: ConfigNode, dataset, processor,
                          cfg_key: str, batch_size: int, seed: int,
-                         host_rows=None):
+                         host_rows=None, model=None):
     dl_cfg = cfg.get(cfg_key)
     kwargs: Dict[str, Any] = {}
     if isinstance(dl_cfg, ConfigNode):
@@ -111,7 +130,8 @@ def build_vlm_dataloader(cfg: ConfigNode, dataset, processor,
         from automodel_tpu.config.loader import resolve_target
 
         cls = resolve_target(target)
-    return cls(dataset, collate_fn=select_collate_fn(dl_cfg, processor),
+    return cls(dataset,
+               collate_fn=select_collate_fn(dl_cfg, processor, model=model),
                **kwargs)
 
 
@@ -130,6 +150,8 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
         otherwise either fail an opaque reshape or — when the patch count
         happens to divide — silently run with wrong rope tables and window
         partition."""
+        import numpy as np
+
         for key, static in (("image_grid_thw",
                              getattr(self.model, "image_grid", None)),
                             ("video_grid_thw",
@@ -140,8 +162,6 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
                 g = mb.get(key)
                 if g is None:
                     continue
-                import numpy as np
-
                 rows = np.asarray(g)
                 real = rows[np.any(rows != 0, axis=-1)]  # zero rows = padding
                 if real.size and not np.all(real == np.asarray(static)):
@@ -212,14 +232,14 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
         self.dataloader = build_vlm_dataloader(
             cfg, dataset, self.processor, "dataloader",
             batch_size=global_mb, seed=self.rng.seed,
-            host_rows=self._host_rows)
+            host_rows=self._host_rows, model=self.model)
         self.val_dataloader = None
         if cfg.get("validation_dataset") is not None:
             val_ds = build_dataset(cfg.get("validation_dataset"))
             # validation stays on the global loader (see the LLM recipe)
             self.val_dataloader = build_vlm_dataloader(
                 cfg, val_ds, self.processor, "validation_dataloader",
-                batch_size=global_mb, seed=self.rng.seed)
+                batch_size=global_mb, seed=self.rng.seed, model=self.model)
 
 
 def main(config_path: Optional[str] = None, argv=None):

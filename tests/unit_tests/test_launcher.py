@@ -100,3 +100,23 @@ def test_k8s_manifest_renders_and_routes(tmp_path, monkeypatch):
 
     with pytest.raises(ValueError):
         K8sConfig.from_cfg({"bogus_key": 1})
+
+
+def test_k8s_manifest_escapes_hostile_values():
+    """Env values / commands with quotes, colons, and newlines must survive
+    the YAML round-trip (the old f-string renderer emitted invalid or
+    restructured manifests)."""
+    import yaml as _yaml
+
+    from automodel_tpu.launcher.k8s.utils import K8sConfig, render_manifest
+
+    k = K8sConfig(env_vars={"TRICKY": 'va"l: ue\nwith newline'})
+    m = render_manifest(k, 'echo "hi: there" && run',
+                        config_yaml='a: "b"\nc: d')
+    docs = list(_yaml.safe_load_all(m))
+    assert [d["kind"] for d in docs] == ["ConfigMap", "Service", "Job"]
+    c = docs[2]["spec"]["template"]["spec"]["containers"][0]
+    assert c["args"] == ['echo "hi: there" && run']
+    envs = {e["name"]: e.get("value") for e in c["env"]}
+    assert envs["TRICKY"] == 'va"l: ue\nwith newline'
+    assert docs[0]["data"]["config.yaml"] == 'a: "b"\nc: d'

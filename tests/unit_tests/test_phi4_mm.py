@@ -114,6 +114,43 @@ def test_audio_text_logits_match_transformers(tmp_path):
                                atol=5e-4, rtol=3e-3)
 
 
+def test_ragged_audio_mask_matches_transformers(tmp_path):
+    """Padded batch of UNEQUAL clip lengths with audio_attention_mask: pins
+    the ceil(lens/time_reduction) sub-length path and the HF additive
+    bool-mask quirk (hs_mask + relative bias) against transformers — the
+    full-length parity test cannot catch off-by-one subsampled mask
+    lengths."""
+    model = _model()
+    params = _randomized(model, jax.random.key(5))
+    hf = _export(model, params, tmp_path)
+    rng = np.random.default_rng(5)
+    frames = [40, 24]               # -> 10 and 6 post-subsampling tokens
+    t_max = max(frames)
+    feats = np.zeros((2, t_max, 20), np.float32)
+    mask = np.zeros((2, t_max), bool)
+    for i, f in enumerate(frames):
+        feats[i, :f] = rng.normal(size=(f, 20))
+        mask[i, :f] = True
+    sizes = np.asarray([10, 6], np.int64)
+    rows = []
+    for n_tok in sizes:
+        row = (rng.integers(1, 190, 4).tolist() + [AUDIO_TOKEN] * int(n_tok)
+               + rng.integers(1, 190, 5).tolist())
+        rows.append(row + [0] * (19 - len(row)))
+    ids = np.asarray(rows, np.int64)
+    with torch.no_grad():
+        ref = hf(input_ids=torch.from_numpy(ids),
+                 audio_input_features=torch.from_numpy(feats),
+                 audio_embed_sizes=torch.from_numpy(sizes),
+                 audio_attention_mask=torch.from_numpy(mask)).logits.numpy()
+    ours = model(params, jnp.asarray(ids, jnp.int32),
+                 input_audio_embeds=jnp.asarray(feats),
+                 audio_embed_sizes=jnp.asarray(sizes, jnp.int32),
+                 audio_attention_mask=jnp.asarray(mask))["logits"]
+    np.testing.assert_allclose(np.asarray(ours, np.float32), ref,
+                               atol=5e-4, rtol=3e-3)
+
+
 def test_text_only_logits_and_generate(tmp_path):
     from automodel_tpu.generation import GenerationConfig, generate
 

@@ -77,6 +77,55 @@ def test_gradients_match_sdpa():
         assert float(jnp.max(jnp.abs(a - b))) / scale < 5e-3
 
 
+def test_seq_alignment_padding_matches_sdpa():
+    """S = odd multiple of 128 routes through the internal pad-to-256 path
+    (kernel blocks stay >= 256): outputs and gradients must equal SDPA on
+    the unpadded shape, with and without segment ids."""
+    S_odd = 384        # % 256 != 0 -> internal pad to 512
+    kq, kk, kv = jax.random.split(jax.random.key(6), 3)
+    q = jax.random.normal(kq, (B, S_odd, Hq, D), jnp.float32)
+    k = jax.random.normal(kk, (B, S_odd, Hk, D), jnp.float32)
+    v = jax.random.normal(kv, (B, S_odd, Hk, D), jnp.float32)
+
+    out = sa.splash_attention_bshd(q, k, v, causal=True)
+    assert out.shape == (B, S_odd, Hq, D)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+    seg = np.ones((B, S_odd), np.int32)
+    seg[:, S_odd // 2:] = 2
+    seg = jnp.asarray(seg)
+    out = sa.splash_attention_bshd(q, k, v, causal=True, segment_ids=seg)
+    ref = dot_product_attention(q, k, v, causal=True, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v, causal=True) ** 2)
+
+    gs = jax.grad(loss(sa.splash_attention_bshd), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(dot_product_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gr):
+        assert a.shape == b.shape
+        scale = float(jnp.max(jnp.abs(b))) + 1e-9
+        assert float(jnp.max(jnp.abs(a - b))) / scale < 5e-3
+
+
+def test_seq_alignment_padding_sliding_window():
+    """Alignment padding composes with LocalMask sliding windows."""
+    S_odd = 384
+    kq, kk, kv = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(kq, (B, S_odd, Hq, D), jnp.float32)
+    k = jax.random.normal(kk, (B, S_odd, Hk, D), jnp.float32)
+    v = jax.random.normal(kv, (B, S_odd, Hk, D), jnp.float32)
+    out = sa.splash_attention_bshd(q, k, v, causal=True,
+                                   local_window_size=32)
+    ref = dot_product_attention(q, k, v, causal=True, local_window_size=32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+
 def test_sliding_window_local_mask():
     """LocalMask wiring: window w must match SDPA's q - kv < w exactly
     (discriminates w from w±1)."""
