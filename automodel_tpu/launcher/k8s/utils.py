@@ -78,7 +78,10 @@ def render_manifest(k8s: K8sConfig, command: str,
     docs.append({
         "apiVersion": "v1", "kind": "Service",
         "metadata": {"name": k8s.job_name, "namespace": k8s.namespace},
-        "spec": {"clusterIP": None,
+        # the literal string "None" — k8s's headless-Service marker; a YAML
+        # null would leave the field unset and the API server would assign
+        # a ClusterIP, killing the per-pod DNS the coordinator needs
+        "spec": {"clusterIP": "None",
                  "selector": {"job-name": k8s.job_name}},
     })
     docs.append({

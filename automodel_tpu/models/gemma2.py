@@ -1,0 +1,60 @@
+"""Gemma-2 text family (HF ``model_type: gemma2``).
+
+The reference trains Gemma-2 through HF transformers
+(``nemo_automodel/components/_transformers/auto_model.py:384``); parity
+target is ``transformers/models/gemma2/modeling_gemma2.py``.  The
+architecture is the Gemma-3 decoder (``models/gemma3.py``: sqrt-H embed
+scaling, zero-centered (1+w) norms, four norms per layer, GeGLU,
+query_pre_attn_scalar scaling, alternating sliding/full attention) minus
+the q/k norms and plus logit softcapping — both config-driven branches of
+the shared body:
+
+* ``attn_logit_softcapping`` (50.0): tanh cap on attention logits;
+* ``final_logit_softcapping`` (30.0): tanh cap on lm_head logits;
+* single rope base for sliding AND full layers (Gemma-3 added the dual
+  local/global bases; here ``rope_local_base_freq`` is pinned to
+  ``rope_theta`` so both precomputed tables coincide);
+* alternating layer types starting with sliding (HF Gemma-2 ordering).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+from automodel_tpu.models.gemma3 import Gemma3Config, Gemma3ForCausalLM
+
+
+@dataclasses.dataclass
+class Gemma2Config(Gemma3Config):
+    """HF ``Gemma2Config`` field names on the shared Gemma superset."""
+
+    qk_norm: bool = False
+    attn_logit_softcapping: float = 50.0
+    final_logit_softcapping: float = 30.0
+    rope_theta: float = 10_000.0
+
+    def __post_init__(self):
+        if self.layer_types is None:
+            # HF Gemma-2: even layers sliding, odd layers full
+            self.layer_types = [
+                "sliding_attention" if i % 2 == 0 else "full_attention"
+                for i in range(self.num_hidden_layers)]
+        super().__post_init__()
+        # one rope base for every layer (no local/global split in Gemma-2)
+        self.rope_local_base_freq = self.rope_theta
+        self.model_type = "gemma2"
+
+    @classmethod
+    def from_hf_config(cls, hf: Dict[str, Any]) -> "Gemma2Config":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in hf.items() if k in known}
+        kwargs.pop("rope_local_base_freq", None)   # derived from rope_theta
+        kwargs.pop("qk_norm", None)                # not a Gemma-2 concept
+        return cls(**kwargs)
+
+
+class Gemma2ForCausalLM(Gemma3ForCausalLM):
+    """``model._target_: automodel_tpu.models.auto_model.build_model`` with
+    ``model_type: gemma2`` — the shared Gemma decoder with softcapping on
+    and q/k norms off."""

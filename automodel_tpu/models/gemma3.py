@@ -57,6 +57,11 @@ class Gemma3Config:
     attention_bias: bool = False
     model_type: str = "gemma3_text"
     torch_dtype: str = "bfloat16"
+    # Gemma-2 deltas (Gemma-3 dropped softcapping and added q/k norms);
+    # the shared decoder branches on these so one body serves both.
+    qk_norm: bool = True
+    attn_logit_softcapping: Optional[float] = None
+    final_logit_softcapping: Optional[float] = None
 
     def __post_init__(self):
         if self.layer_types is None:
@@ -116,8 +121,6 @@ class Gemma3ForCausalLM:
                     "k_proj": {"kernel": dense(next(keys), (H, Hk * D))},
                     "v_proj": {"kernel": dense(next(keys), (H, Hk * D))},
                     "o_proj": {"kernel": dense(next(keys), (Hq * D, H))},
-                    "q_norm": {"weight": zeros((L, D))},
-                    "k_norm": {"weight": zeros((L, D))},
                 },
                 "post_attention_layernorm": {"weight": zeros((L, H))},
                 "pre_feedforward_layernorm": {"weight": zeros((L, H))},
@@ -130,6 +133,11 @@ class Gemma3ForCausalLM:
             },
             "norm": {"weight": zeros((H,))},
         }
+        if cfg.qk_norm:
+            params["layers"]["self_attn"]["q_norm"] = {
+                "weight": zeros((L, D))}
+            params["layers"]["self_attn"]["k_norm"] = {
+                "weight": zeros((L, D))}
         if not cfg.tie_word_embeddings:
             params["lm_head"] = {"kernel": (jax.random.normal(
                 next(keys), (H, cfg.vocab_size), jnp.float32)
@@ -150,8 +158,6 @@ class Gemma3ForCausalLM:
                     "k_proj": {"kernel": ("layers", "embed", "heads")},
                     "v_proj": {"kernel": ("layers", "embed", "heads")},
                     "o_proj": {"kernel": ("layers", "heads", "embed")},
-                    "q_norm": {"weight": ("layers", "head_dim")},
-                    "k_norm": {"weight": ("layers", "head_dim")},
                 },
                 "post_attention_layernorm": {"weight": ("layers", "norm")},
                 "pre_feedforward_layernorm": {"weight": ("layers", "norm")},
@@ -164,6 +170,11 @@ class Gemma3ForCausalLM:
             },
             "norm": {"weight": ("norm",)},
         }
+        if cfg.qk_norm:
+            axes["layers"]["self_attn"]["q_norm"] = {
+                "weight": ("layers", "head_dim")}
+            axes["layers"]["self_attn"]["k_norm"] = {
+                "weight": ("layers", "head_dim")}
         if not cfg.tie_word_embeddings:
             axes["lm_head"] = {"kernel": ("embed", "vocab")}
         return axes
@@ -185,11 +196,15 @@ class Gemma3ForCausalLM:
         q = proj(x, p["self_attn"]["q_proj"]).reshape(B, S, Hq, D)
         k = proj(x, p["self_attn"]["k_proj"]).reshape(B, S, Hk, D)
         v = proj(x, p["self_attn"]["v_proj"]).reshape(B, S, Hk, D)
-        q = rms_norm(q, p["self_attn"]["q_norm"]["weight"], eps, offset=1.0)
-        k = rms_norm(k, p["self_attn"]["k_norm"]["weight"], eps, offset=1.0)
+        if cfg.qk_norm:
+            q = rms_norm(q, p["self_attn"]["q_norm"]["weight"], eps,
+                         offset=1.0)
+            k = rms_norm(k, p["self_attn"]["k_norm"]["weight"], eps,
+                         offset=1.0)
         q, k = apply_rope(q, k, position_ids, inv_freq)
         scale = float(cfg.query_pre_attn_scalar) ** -0.5
         scale_ = scale
+        soft_cap = cfg.attn_logit_softcapping
         sliding = int(cfg.sliding_window)
 
         def by_window(fn, *operands, **kwargs):
@@ -217,16 +232,19 @@ class Gemma3ForCausalLM:
             if S > 1:
                 attn = by_window(
                     attention, q, k, v, causal=True, scale=scale_,
+                    logits_soft_cap=soft_cap,
                     attention_mask=(None if attention_mask is None
                                     else attention_mask[:, :S]))
             else:
                 attn = by_window(
                     cached_attention, q, k_cache, v_cache,
                     cache_index=cache_index, q_len=S,
-                    attention_mask=attention_mask, scale=scale_)
+                    attention_mask=attention_mask, scale=scale_,
+                    logits_soft_cap=soft_cap)
         else:
             attn = by_window(
                 attention, q, k, v, causal=True, scale=scale_,
+                logits_soft_cap=soft_cap,
                 segment_ids=segment_ids, attention_mask=attention_mask)
         attn = proj(attn.reshape(B, S, Hq * D), p["self_attn"]["o_proj"])
         attn = rms_norm(attn, p["post_attention_layernorm"]["weight"], eps,
@@ -307,8 +325,20 @@ class Gemma3ForCausalLM:
                      if cfg.tie_word_embeddings
                      else params["lm_head"]["kernel"])
         if return_hidden:
+            if cfg.final_logit_softcapping is not None:
+                # the fused hidden@lm_head loss path cannot apply the tanh
+                # cap — training would silently diverge from HF semantics
+                raise NotImplementedError(
+                    "final_logit_softcapping (Gemma-2) is incompatible with "
+                    "hidden-state losses (FusedLinearCrossEntropy): the cap "
+                    "must apply to the full logits; use a logits loss "
+                    "(e.g. MaskedCrossEntropy) for this family")
             return {"hidden_states": hidden, "lm_head_kernel": lm_kernel}
         logits = hidden @ lm_kernel.astype(self.compute_dtype)
+        if cfg.final_logit_softcapping is not None:
+            cap = jnp.asarray(cfg.final_logit_softcapping, jnp.float32)
+            logits = (jnp.tanh(logits.astype(jnp.float32) / cap)
+                      * cap).astype(logits.dtype)
         out = {"logits": constrain(
             logits, ("act_batch", "act_seq_nosp", "act_vocab"))}
         if decoding:

@@ -121,6 +121,22 @@ def mixtral_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
     return m
 
 
+def qwen3_moe_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    """Qwen3-MoE (HF ``Qwen3MoeForCausalLM`` naming): Qwen3 attention
+    (q/k norms via the llama map) plus ``mlp.gate`` router and per-expert
+    ``mlp.experts.{e}.gate_proj/up_proj/down_proj``."""
+    m = llama_key_map(config)
+    for proj in ("gate_proj", "up_proj", "down_proj"):
+        del m[("layers", "mlp", proj, "kernel")]
+    m[("layers", "mlp", "gate", "kernel")] = HfSpec(
+        "model.layers.{i}.mlp.gate.weight", stacked=True, transpose=True)
+    for proj in ("gate_proj", "up_proj", "down_proj"):
+        m[("layers", "mlp", "experts", proj, "kernel")] = HfSpec(
+            f"model.layers.{{i}}.mlp.experts.{{e}}.{proj}.weight",
+            stacked=True, expert_stacked=True, transpose=True)
+    return m
+
+
 def gemma3_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
     """Gemma-3 text (HF ``Gemma3ForCausalLM`` naming — llama-like plus q/k
     norms and pre/post feedforward norms)."""
@@ -136,15 +152,91 @@ def gemma3_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
         m[("layers", "self_attn", proj, "kernel")] = HfSpec(
             f"model.layers.{{i}}.self_attn.{proj}.weight", stacked=True,
             transpose=True)
-    for norm in ("q_norm", "k_norm"):
-        m[("layers", "self_attn", norm, "weight")] = HfSpec(
-            f"model.layers.{{i}}.self_attn.{norm}.weight", stacked=True)
+    if getattr(config, "qk_norm", True):   # Gemma-2 has no q/k norms
+        for norm in ("q_norm", "k_norm"):
+            m[("layers", "self_attn", norm, "weight")] = HfSpec(
+                f"model.layers.{{i}}.self_attn.{norm}.weight", stacked=True)
     for proj in ("gate_proj", "up_proj", "down_proj"):
         m[("layers", "mlp", proj, "kernel")] = HfSpec(
             f"model.layers.{{i}}.mlp.{proj}.weight", stacked=True,
             transpose=True)
     if not config.tie_word_embeddings:
         m[("lm_head", "kernel")] = HfSpec("lm_head.weight", transpose=True)
+    return m
+
+
+def gemma3n_text_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    """Gemma-3n text (HF ``Gemma3nForCausalLM`` naming): the Gemma-3 layer
+    set (shared via :func:`gemma3_key_map`) plus AltUp / Laurel /
+    per-layer-embedding tensors."""
+    m = gemma3_key_map(config)
+    m.pop(("lm_head", "kernel"), None)    # gemma3n is always tied
+    m.update({
+        ("embed_tokens_per_layer", "embedding"): HfSpec(
+            "model.embed_tokens_per_layer.weight"),
+        ("per_layer_model_projection", "kernel"): HfSpec(
+            "model.per_layer_model_projection.weight", transpose=True),
+        ("per_layer_projection_norm", "weight"): HfSpec(
+            "model.per_layer_projection_norm.weight"),
+        ("altup_projections", "kernel"): HfSpec(
+            "model.altup_projections.{i}.weight", stacked=True,
+            transpose=True),
+        ("altup_unembed_projections", "kernel"): HfSpec(
+            "model.altup_unembed_projections.{i}.weight", stacked=True,
+            transpose=True),
+    })
+    m[("layers", "altup", "correct_output_scale")] = HfSpec(
+        "model.layers.{i}.altup.correct_output_scale", stacked=True)
+    for lin in ("correction_coefs", "prediction_coefs", "modality_router"):
+        m[("layers", "altup", lin, "kernel")] = HfSpec(
+            f"model.layers.{{i}}.altup.{lin}.weight", stacked=True,
+            transpose=True)
+    m[("layers", "altup", "router_norm", "weight")] = HfSpec(
+        "model.layers.{i}.altup.router_norm.weight", stacked=True)
+    for lin in ("linear_left", "linear_right"):
+        m[("layers", "laurel", lin, "kernel")] = HfSpec(
+            f"model.layers.{{i}}.laurel.{lin}.weight", stacked=True,
+            transpose=True)
+    m[("layers", "laurel", "post_laurel_norm", "weight")] = HfSpec(
+        "model.layers.{i}.laurel.post_laurel_norm.weight", stacked=True)
+    for lin in ("per_layer_input_gate", "per_layer_projection"):
+        m[("layers", lin, "kernel")] = HfSpec(
+            f"model.layers.{{i}}.{lin}.weight", stacked=True, transpose=True)
+    m[("layers", "post_per_layer_input_norm", "weight")] = HfSpec(
+        "model.layers.{i}.post_per_layer_input_norm.weight", stacked=True)
+    return m
+
+
+def gemma3n_vlm_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    """Gemma-3n multimodal (HF ``Gemma3nForConditionalGeneration`` naming):
+    text under ``model.language_model.``, the multimodal embedder under
+    ``model.embed_vision.``; the NATIVE vision tower has no timm
+    counterpart, so its weights live under ``model.vision_tower.native.*``
+    (HF loaders warn + random-init their timm tower — Phi-4-MM precedent)."""
+    text = {
+        ("language_model",) + path: HfSpec(
+            spec.template.replace("model.", "model.language_model.", 1),
+            stacked=spec.stacked, transpose=spec.transpose)
+        for path, spec in gemma3n_text_key_map(config.text_config).items()
+    }
+    ev = "model.embed_vision."
+    m: Dict[Tuple[str, ...], HfSpec] = dict(text)
+    m[("embed_vision", "embedding", "embedding")] = HfSpec(
+        ev + "embedding.weight")
+    m[("embed_vision", "hard_embedding_norm", "weight")] = HfSpec(
+        ev + "hard_embedding_norm.weight")
+    m[("embed_vision", "soft_embedding_norm", "weight")] = HfSpec(
+        ev + "soft_embedding_norm.weight")
+    m[("embed_vision", "embedding_projection", "kernel")] = HfSpec(
+        ev + "embedding_projection.weight", transpose=True)
+    vt = "model.vision_tower.native."
+    m[("vision_tower", "stem", "kernel")] = HfSpec(vt + "stem.kernel")
+    for name in ("expand", "depthwise", "project"):
+        m[("vision_tower", "blocks", name, "kernel")] = HfSpec(
+            vt + f"blocks.{name}.kernel")
+    m[("vision_tower", "blocks", "norm", "weight")] = HfSpec(
+        vt + "blocks.norm.weight")
+    m[("vision_tower", "head", "kernel")] = HfSpec(vt + "head.kernel")
     return m
 
 
@@ -302,12 +394,9 @@ def qwen2_5_vl_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
     return m
 
 
-def phi4_mm_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
-    """Phi-4-multimodal, audio + text scope (no vision tower — see
-    ``models/phi4_mm.py``): Phi decoder with FUSED qkv/gate_up under
-    ``model.layers.``, conformer audio encoder under
-    ``model.embed_tokens_extend.audio_embed.``."""
-    tc = config.text_config
+def phi3_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    """Phi-3 / Phi-4 text (HF ``Phi3ForCausalLM`` naming): the fused
+    qkv_proj / gate_up_proj Phi decoder as a standalone family."""
     m: Dict[Tuple[str, ...], HfSpec] = {
         ("embed_tokens", "embedding"): HfSpec("model.embed_tokens.weight"),
         ("norm", "weight"): HfSpec("model.norm.weight"),
@@ -328,8 +417,17 @@ def phi4_mm_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
             "model.layers.{i}.mlp.down_proj.weight", stacked=True,
             transpose=True),
     }
-    if not tc.tie_word_embeddings:
+    if not config.tie_word_embeddings:
         m[("lm_head", "kernel")] = HfSpec("lm_head.weight", transpose=True)
+    return m
+
+
+def phi4_mm_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    """Phi-4-multimodal, audio + text scope (no vision tower — see
+    ``models/phi4_mm.py``): Phi decoder with FUSED qkv/gate_up under
+    ``model.layers.`` (shared with :func:`phi3_key_map`), conformer audio
+    encoder under ``model.embed_tokens_extend.audio_embed.``."""
+    m = phi3_key_map(config.text_config)
     text = {("language_model",) + path: spec for path, spec in m.items()}
 
     conv1d_load = lambda w: np.asarray(w)[:, :, 0].T     # (O, I, 1) -> (I, O)

@@ -1,0 +1,41 @@
+"""Phi-3 / Phi-4 text family (HF ``model_type: phi3``).
+
+The reference trains these through HF transformers
+(``nemo_automodel/components/_transformers/auto_model.py:384``); parity
+target is ``transformers/models/phi3/modeling_phi3.py``.  The architecture
+is exactly the fused-projection Phi decoder already built for
+Phi-4-multimodal (``models/phi4_mm.py``: fused ``qkv_proj`` /
+``gate_up_proj``, bias-free, partial-rotary support, Llama pre-norm
+residual order) — this module registers it as a standalone text family so
+``microsoft/phi-4`` / Phi-3-mini checkpoints load without the audio tower.
+
+Rope scope: standard rope (+ optional ``partial_rotary_factor``); the
+``longrope`` scaling of the 128k variants is not implemented and fails
+loudly in ``rope_frequencies``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+from automodel_tpu.models.phi4_mm import Phi4MMTextConfig, Phi4MMTextModel
+
+
+@dataclasses.dataclass
+class Phi3Config(Phi4MMTextConfig):
+    """HF ``Phi3Config`` field names (the Phi4MMTextConfig superset)."""
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.model_type = "phi3"
+
+    @classmethod
+    def from_hf_config(cls, hf: Dict[str, Any]) -> "Phi3Config":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in hf.items() if k in known})
+
+
+class Phi3ForCausalLM(Phi4MMTextModel):
+    """``model._target_: automodel_tpu.models.auto_model.build_model`` with
+    ``model_type: phi3`` — the fused-Phi decoder as its own family."""

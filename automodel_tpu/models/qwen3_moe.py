@@ -1,0 +1,127 @@
+"""Qwen3-MoE family (HF ``model_type: qwen3_moe``, e.g. Qwen3-30B-A3B).
+
+The reference trains these through HF transformers
+(``nemo_automodel/components/_transformers/auto_model.py:384``); parity
+target is ``transformers/models/qwen3_moe/modeling_qwen3_moe.py``.  The
+architecture composes two pieces the framework already has:
+
+* **attention** — the Qwen3 variant of the Llama decoder (per-head q/k
+  RMSNorm, explicit ``head_dim``), via ``LlamaConfig.qk_norm``;
+* **FFN** — the Mixtral static-shape dispatch/combine expert block
+  (``ops/moe.py``) with Qwen3's naming (``mlp.gate`` router,
+  ``mlp.experts.{e}.gate_proj/up_proj/down_proj``), expert width
+  ``moe_intermediate_size``, and the ``norm_topk_prob`` routing flag
+  (False keeps the raw softmax mass of the selected experts).
+
+Scope: every layer sparse (``decoder_sparse_step == 1``) with no dense
+``mlp_only_layers`` — the released Qwen3-MoE checkpoints; anything else
+fails loudly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+from automodel_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+from automodel_tpu.ops.moe import moe_mlp_block
+
+
+@dataclasses.dataclass
+class Qwen3MoeConfig(MixtralConfig):
+    """HF ``Qwen3MoeConfig`` field names on the Mixtral superset."""
+
+    num_experts: int = 128
+    moe_intermediate_size: int = 768
+    norm_topk_prob: bool = False
+    decoder_sparse_step: int = 1
+    mlp_only_layers: Tuple[int, ...] = ()
+    router_aux_loss_coef: float = 0.001
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.model_type = "qwen3_moe"
+        self.qk_norm = True                       # always on in Qwen3
+        self.num_local_experts = self.num_experts  # HF name difference
+        if int(self.decoder_sparse_step) != 1 or tuple(self.mlp_only_layers):
+            raise NotImplementedError(
+                "qwen3_moe: only the all-sparse layout is implemented "
+                f"(decoder_sparse_step={self.decoder_sparse_step}, "
+                f"mlp_only_layers={self.mlp_only_layers}); the released "
+                "Qwen3-MoE checkpoints use decoder_sparse_step=1 with no "
+                "dense layers")
+
+
+class Qwen3MoeForCausalLM(MixtralForCausalLM):
+    """Qwen3 attention x Mixtral expert dispatch.
+
+    Param tree per layer (stacked over ``L``):
+      ``mlp/gate/kernel``               [L, H, E]
+      ``mlp/experts/gate_proj/kernel``  [L, E, H, I_moe]
+      ``mlp/experts/up_proj/kernel``    [L, E, H, I_moe]
+      ``mlp/experts/down_proj/kernel``  [L, E, I_moe, H]
+    (HF expert-module names, so the key map stays 1:1.)
+    """
+
+    def _init_ffn(self, keys, dense):
+        cfg = self.config
+        H, I, E = (cfg.hidden_size, cfg.moe_intermediate_size,
+                   cfg.num_experts)
+        return {
+            "mlp": {
+                "gate": {"kernel": dense(next(keys), (H, E))},
+                "experts": {
+                    "gate_proj": {"kernel": dense(next(keys), (E, H, I))},
+                    "up_proj": {"kernel": dense(next(keys), (E, H, I))},
+                    "down_proj": {"kernel": dense(next(keys), (E, I, H))},
+                },
+            },
+        }
+
+    def _ffn_axes(self):
+        return {
+            "mlp": {
+                "gate": {"kernel": ("layers", "embed", None)},
+                "experts": {
+                    "gate_proj": {
+                        "kernel": ("layers", "experts", "embed",
+                                   "expert_mlp")},
+                    "up_proj": {
+                        "kernel": ("layers", "experts", "embed",
+                                   "expert_mlp")},
+                    "down_proj": {
+                        "kernel": ("layers", "experts", "expert_mlp",
+                                   "embed")},
+                },
+            },
+        }
+
+    def _mlp_block(self, x, p, proj):
+        cfg = self.config
+        moe = p["mlp"]
+        return moe_mlp_block(
+            x,
+            moe["gate"]["kernel"],
+            moe["experts"]["gate_proj"]["kernel"],
+            moe["experts"]["up_proj"]["kernel"],
+            moe["experts"]["down_proj"]["kernel"],
+            num_experts_per_tok=cfg.num_experts_per_tok,
+            capacity_factor=cfg.moe_capacity_factor,
+            group_size=cfg.moe_group_size,
+            compute_dtype=self.compute_dtype,
+            norm_topk=bool(cfg.norm_topk_prob),
+        )
+
+    def flops_per_token(self) -> float:
+        cfg = self.config
+        attn = (
+            2 * cfg.hidden_size
+            * (cfg.num_attention_heads + 2 * cfg.num_key_value_heads)
+            * cfg.head_dim
+            + 2 * cfg.num_attention_heads * cfg.head_dim * cfg.hidden_size
+        )
+        ffn = (cfg.num_experts_per_tok * 6 * cfg.hidden_size
+               * cfg.moe_intermediate_size)
+        router = 2 * cfg.hidden_size * cfg.num_experts
+        embed = 2 * cfg.vocab_size * cfg.hidden_size
+        return 3.0 * (cfg.num_hidden_layers * (attn + ffn + router) + embed)

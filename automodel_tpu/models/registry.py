@@ -111,3 +111,37 @@ def _ensure_builtin() -> None:
     register_model(ModelFamily("phi4_multimodal", Phi4MMConfig,
                                Phi4MMForCausalLM, hf_io.phi4_mm_key_map,
                                ["Phi4MultimodalForCausalLM"]))
+    from automodel_tpu.models.phi3 import Phi3Config, Phi3ForCausalLM
+
+    register_model(ModelFamily("phi3", Phi3Config, Phi3ForCausalLM,
+                               hf_io.phi3_key_map, ["Phi3ForCausalLM"]))
+    from automodel_tpu.models.gemma2 import Gemma2Config, Gemma2ForCausalLM
+
+    register_model(ModelFamily("gemma2", Gemma2Config, Gemma2ForCausalLM,
+                               hf_io.gemma3_key_map, ["Gemma2ForCausalLM"]))
+    from automodel_tpu.models.qwen3_moe import (
+        Qwen3MoeConfig,
+        Qwen3MoeForCausalLM,
+    )
+
+    register_model(ModelFamily("qwen3_moe", Qwen3MoeConfig,
+                               Qwen3MoeForCausalLM, hf_io.qwen3_moe_key_map,
+                               ["Qwen3MoeForCausalLM"]))
+    from automodel_tpu.models.gemma3n import (
+        Gemma3nForCausalLM,
+        Gemma3nTextConfig,
+    )
+
+    register_model(ModelFamily("gemma3n_text", Gemma3nTextConfig,
+                               Gemma3nForCausalLM,
+                               hf_io.gemma3n_text_key_map,
+                               ["Gemma3nForCausalLM"]))
+    from automodel_tpu.models.gemma3n import (
+        Gemma3nForConditionalGeneration,
+        Gemma3nVLConfig,
+    )
+
+    register_model(ModelFamily("gemma3n", Gemma3nVLConfig,
+                               Gemma3nForConditionalGeneration,
+                               hf_io.gemma3n_vlm_key_map,
+                               ["Gemma3nForConditionalGeneration"]))

@@ -131,6 +131,7 @@ def cached_attention(
     q_len: int,
     attention_mask: Optional[jnp.ndarray] = None,  # [B, S_max] padding mask
     scale: Optional[float] = None,
+    logits_soft_cap: Optional[float] = None,
     local_window_size: Optional[int | jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Decode-step attention over a static kv cache.
@@ -145,6 +146,7 @@ def cached_attention(
     return dot_product_attention(
         q, k_cache, v_cache, causal=True, q_offset=cache_index,
         attention_mask=attention_mask, scale=scale,
+        logits_soft_cap=logits_soft_cap,
         local_window_size=local_window_size)
 
 

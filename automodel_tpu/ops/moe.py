@@ -28,15 +28,19 @@ from jax import lax
 from automodel_tpu.distributed.shardings import constrain
 
 
-def topk_routing(router_logits: jnp.ndarray, k: int
+def topk_routing(router_logits: jnp.ndarray, k: int, norm_topk: bool = True
                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """HF Mixtral routing: fp32 softmax over all experts, top-k, renormalize.
+
+    ``norm_topk=False`` (Qwen3-MoE's ``norm_topk_prob: false``) keeps the raw
+    softmax mass of the selected experts instead of renormalizing to 1.
 
     Returns ``(weights [..., k], expert_idx [..., k], probs [..., E])``.
     """
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
     weights, idx = lax.top_k(probs, k)
-    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    if norm_topk:
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
     return weights, idx, probs
 
 
@@ -84,6 +88,7 @@ def moe_mlp_block(
     capacity_factor: Optional[float] = 2.0,
     group_size: int = 512,
     compute_dtype: jnp.dtype = jnp.bfloat16,
+    norm_topk: bool = True,
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """Top-k routed SwiGLU expert FFN.  Returns ``(out [B, S, H],
     (tokens_per_expert [k, E], router_prob [E]))`` — see
@@ -113,7 +118,8 @@ def moe_mlp_block(
 
     # Router in fp32 (HF computes gating in float32 for stability).
     router_logits = xg.astype(jnp.float32) @ gate_kernel.astype(jnp.float32)
-    weights, idx, probs = topk_routing(router_logits, k)        # [G, M, k]
+    weights, idx, probs = topk_routing(router_logits, k,
+                                       norm_topk=norm_topk)     # [G, M, k]
     aux = routing_stats(probs, idx, E)
 
     # Dispatch/combine build, slot-major priority (GShard): slot j's

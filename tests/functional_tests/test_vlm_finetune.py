@@ -167,6 +167,23 @@ def test_qwen25_vl_video_recipe_trains(tmp_path):
     assert recipe.last_metrics["loss"] < first["loss"]
 
 
+def test_gemma3n_recipe_trains(tmp_path):
+    """Gemma-3n end-to-end through the VLM recipe (the reference's medpix
+    example at tiny scale): default collator -> native vision tower +
+    multimodal embedder + altup/laurel/PLE decoder; loss descends."""
+    from automodel_tpu.recipes.vlm.finetune import FinetuneRecipeForVLM
+
+    yaml = os.path.join(os.path.dirname(__file__), "..", "..", "examples",
+                        "vlm_finetune", "tiny_gemma3n_mock.yaml")
+    cfg = parse_args_and_load_config(["--config", yaml])
+    recipe = FinetuneRecipeForVLM(cfg).setup()
+    first = recipe._run_train_optim_step(next(iter(recipe.step_scheduler)))
+    recipe.run_train_validation_loop()
+    assert recipe.step_scheduler.step == 6
+    assert np.isfinite(recipe.last_metrics["loss"])
+    assert recipe.last_metrics["loss"] < first["loss"]
+
+
 def test_phi4_mm_recipe_trains(tmp_path):
     """Phi-4-MM audio end-to-end through the VLM recipe: the COLLATE_FNS
     dispatch routes the Phi4MMProcessor to the phi4 collator, whose audio

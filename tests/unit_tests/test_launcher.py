@@ -115,6 +115,9 @@ def test_k8s_manifest_escapes_hostile_values():
                         config_yaml='a: "b"\nc: d')
     docs = list(_yaml.safe_load_all(m))
     assert [d["kind"] for d in docs] == ["ConfigMap", "Service", "Job"]
+    # headless marker must be the STRING "None" (YAML null would unset the
+    # field and the Service would get a ClusterIP — no per-pod DNS)
+    assert docs[1]["spec"]["clusterIP"] == "None"
     c = docs[2]["spec"]["template"]["spec"]["containers"][0]
     assert c["args"] == ['echo "hi: there" && run']
     envs = {e["name"]: e.get("value") for e in c["env"]}

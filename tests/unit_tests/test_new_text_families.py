@@ -1,0 +1,163 @@
+"""HF parity for the round-4 text families: phi3 (fused-projection Phi
+decoder standalone), gemma2 (softcapping + no q/k norms on the shared Gemma
+body), qwen3_moe (Qwen3 attention x Mixtral expert dispatch).
+
+Same harness as ``test_hf_parity.py``: save a tiny randomly-initialized
+native model as a consolidated HF repo, reload with ``transformers`` in
+fp32, pin logits / masked-CE loss / greedy decode.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from automodel_tpu.loss.masked_ce import cross_entropy_sum
+from automodel_tpu.models.gemma2 import Gemma2Config, Gemma2ForCausalLM
+from automodel_tpu.models.phi3 import Phi3Config, Phi3ForCausalLM
+from automodel_tpu.models.qwen3_moe import Qwen3MoeConfig, Qwen3MoeForCausalLM
+
+
+def _phi3_case():
+    cfg = Phi3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        max_position_embeddings=64, partial_rotary_factor=0.5)
+    return cfg, Phi3ForCausalLM
+
+
+def _gemma2_case():
+    cfg = Gemma2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, query_pre_attn_scalar=16.0, sliding_window=8,
+        max_position_embeddings=64, tie_word_embeddings=True)
+    return cfg, Gemma2ForCausalLM
+
+
+def _qwen3_moe_case():
+    cfg = Qwen3MoeConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rope_theta=10000.0, tie_word_embeddings=True,
+        max_position_embeddings=64,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=48,
+        norm_topk_prob=True,
+        moe_capacity_factor=None)       # lossless: exact HF parity
+    return cfg, Qwen3MoeForCausalLM
+
+
+CASES = {
+    "phi3": _phi3_case,
+    "gemma2": _gemma2_case,
+    "qwen3_moe": _qwen3_moe_case,
+}
+
+
+def _randomized(model, key):
+    params = model.init(key)
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(jax.random.fold_in(key, 7), len(leaves))
+    leaves = [
+        (l + 0.02 * jax.random.normal(k, l.shape, jnp.float32)).astype(l.dtype)
+        for l, k in zip(leaves, keys)
+    ]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def _export(model, params, path):
+    """Consolidated HF repo + safe token ids for the tiny vocab (HF family
+    defaults like phi3's pad 32000 exceed vocab 256)."""
+    from automodel_tpu.models.hf_io import save_hf_weights
+
+    save_hf_weights(model, params, str(path))
+    cfg_path = os.path.join(str(path), "config.json")
+    with open(cfg_path) as f:
+        d = json.load(f)
+    d.update(pad_token_id=0, bos_token_id=1, eos_token_id=2)
+    with open(cfg_path, "w") as f:
+        json.dump(d, f, indent=2, default=str)
+    hf = transformers.AutoModelForCausalLM.from_pretrained(
+        str(path), torch_dtype=torch.float32, attn_implementation="eager")
+    hf.eval()
+    return hf
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_logits_and_loss_match_transformers(name, tmp_path):
+    cfg, cls = CASES[name]()
+    model = cls(cfg, param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                remat=False)
+    params = _randomized(model, jax.random.key(0))
+    hf = _export(model, params, tmp_path)
+
+    rng = np.random.default_rng(0)
+    B, S = 2, 24
+    input_ids = rng.integers(3, cfg.vocab_size, (B, S), dtype=np.int64)
+    labels = input_ids.copy()
+    labels[0, :5] = -100
+    labels[:, -2:] = -100
+
+    with torch.no_grad():
+        out = hf(input_ids=torch.from_numpy(input_ids),
+                 labels=torch.from_numpy(labels))
+    hf_logits = out.logits.numpy()
+
+    res = model(params, jnp.asarray(input_ids, jnp.int32))
+    ours = np.asarray(res["logits"], dtype=np.float32)
+    np.testing.assert_allclose(ours, hf_logits, atol=3e-4, rtol=3e-3)
+
+    shifted = jnp.asarray(labels[:, 1:])
+    n_tok = jnp.maximum(jnp.sum(shifted != -100), 1)
+    our_loss = cross_entropy_sum(jnp.asarray(ours)[:, :-1], shifted) / n_tok
+    np.testing.assert_allclose(
+        float(our_loss), float(out.loss), atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_greedy_generate_matches_transformers(name, tmp_path):
+    from automodel_tpu.generation import GenerationConfig, generate
+
+    cfg, cls = CASES[name]()
+    model = cls(cfg, param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                remat=False)
+    params = _randomized(model, jax.random.key(3))
+    hf = _export(model, params, tmp_path)
+
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(3, cfg.vocab_size - 1, (1, 9)).astype(np.int64)
+    ours = generate(model, params, prompt,
+                    config=GenerationConfig(max_new_tokens=6))
+    with torch.no_grad():
+        hf_out = hf.generate(torch.from_numpy(prompt), max_new_tokens=6,
+                             do_sample=False, pad_token_id=0)
+    np.testing.assert_array_equal(ours[0], hf_out[0, 9:].numpy())
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_hf_roundtrip_bitwise(name, tmp_path):
+    from automodel_tpu.models.hf_io import load_hf_weights, save_hf_weights
+
+    cfg, cls = CASES[name]()
+    model = cls(cfg, param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                remat=False)
+    params = _randomized(model, jax.random.key(5))
+    save_hf_weights(model, params, str(tmp_path))
+    back = load_hf_weights(model, str(tmp_path))
+    diffs = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), params, back)
+    assert max(jax.tree.leaves(diffs)) == 0.0
+
+
+def test_qwen3_moe_unsupported_layouts_fail_loudly():
+    with pytest.raises(NotImplementedError):
+        Qwen3MoeConfig(num_hidden_layers=2, decoder_sparse_step=2)
+    with pytest.raises(NotImplementedError):
+        Qwen3MoeConfig(num_hidden_layers=4, mlp_only_layers=(1,))

@@ -55,6 +55,75 @@ def test_every_reference_example_target_resolves():
     assert not unresolved, unresolved
 
 
+_MODEL_ID_TO_TYPE = [
+    # (substring of the HF model id, HF model_type) — extend when the
+    # reference adds examples; unmatched ids FAIL the test below so a new
+    # reference family cannot slip past the registry unnoticed.
+    ("Qwen2.5-VL", "qwen2_5_vl"),
+    ("Qwen3-", "qwen3"),
+    ("gemma-3n", "gemma3n"),
+    ("gemma-3", "gemma3"),
+    ("gemma-2", "gemma2"),
+    ("Llama-3", "llama"),
+    ("Llama-2", "llama"),
+    ("Phi-4-multimodal", "phi4_multimodal"),
+    ("Phi-4", "phi3"),
+    ("Phi-3", "phi3"),
+    ("Mixtral", "mixtral"),
+]
+
+
+def _model_ids_in_reference_examples():
+    ids = set()
+    for path in glob.glob(os.path.join(REF_EXAMPLES, "**", "*.yaml"),
+                          recursive=True):
+        with open(path) as f:
+            try:
+                data = yaml.safe_load(f)
+            except yaml.YAMLError:
+                continue
+
+        def walk(node):
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    if k == "pretrained_model_name_or_path" and isinstance(
+                            v, str):
+                        ids.add(v.split("#")[0].strip())
+                    else:
+                        walk(v)
+            elif isinstance(node, list):
+                for v in node:
+                    walk(v)
+
+        walk(data)
+    return sorted(ids)
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_EXAMPLES),
+                    reason="reference checkout not mounted")
+def test_every_reference_example_model_family_is_registered():
+    """Every model a reference example YAML names must map to a REGISTERED
+    family — target *resolution* alone cannot catch a missing family (the
+    round-3 gemma3n hole was invisible to CI this way)."""
+    from automodel_tpu.models.registry import get_family
+
+    ids = _model_ids_in_reference_examples()
+    assert ids, "no pretrained_model_name_or_path found in reference examples"
+    problems = []
+    for model_id in ids:
+        mt = next((t for pat, t in _MODEL_ID_TO_TYPE if pat in model_id),
+                  None)
+        if mt is None:
+            problems.append(f"{model_id}: no _MODEL_ID_TO_TYPE entry — add "
+                            "one (and the family, if new)")
+            continue
+        try:
+            get_family(mt)
+        except KeyError as e:
+            problems.append(f"{model_id} -> {mt}: {e}")
+    assert not problems, problems
+
+
 def test_translate_rewrites_framework_paths_only():
     assert translate_target(
         "nemo_automodel.components.loss.masked_ce.MaskedCrossEntropy"
