@@ -818,6 +818,11 @@ class Gemma3nForConditionalGeneration:
         cd = self.compute_dtype
         lp = params["language_model"]
         B, S = input_ids.shape
+        if kv_cache is not None or cache_index is not None:
+            raise NotImplementedError(
+                "gemma3n decode uses the cacheless forward (see the KV "
+                "sharing note in the module docstring); generation runs "
+                "full-prefix forwards")
         # text embeddings (scaled); multimodal placeholder ids embed via the
         # embedder's hard path (HF: ids >= vocab_offset)
         safe = jnp.clip(input_ids, 0, tc.vocab_size - 1)

@@ -643,6 +643,22 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         sched = self.step_scheduler
         is_main = self.dist_info.is_main
         prof = self.profiling
+        from automodel_tpu.utils.sig_utils import (
+            DistributedSignalHandler,
+            get_signal_name,
+        )
+
+        self.preempted = False
+        with DistributedSignalHandler() as preempt:
+            self._train_epochs(sched, is_main, prof, preempt)
+        if self.preempted and is_main:
+            logger.warning(
+                "preemption (%s) handled at step %d: %s, exiting cleanly",
+                get_signal_name(preempt.sig), sched.step,
+                "checkpoint saved" if getattr(self, "_preempt_saved", False)
+                else "checkpointing disabled, nothing saved")
+
+    def _train_epochs(self, sched, is_main, prof, preempt=None):
         for epoch in sched.epochs:
             if hasattr(self.dataloader, "set_epoch"):
                 self.dataloader.set_epoch(epoch)
@@ -688,6 +704,30 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                     self.flush_metrics()
                     self.save_checkpoint(epoch, sched.step)
                     self._last_ckpt_step = sched.step
+                # Preemption poll: signals_received is COLLECTIVE, so all
+                # hosts must call it on the same steps — single-process polls
+                # every step (free); multi-host every 10th (the per-step
+                # allgather would serialize async dispatch; preemption grace
+                # windows are tens of seconds, so a few steps of latency is
+                # fine) and at checkpoint boundaries.
+                poll = (jax.process_count() == 1
+                        or sched.step % 10 == 0 or sched.is_ckpt_step)
+                if preempt is not None and poll \
+                        and preempt.signals_received():
+                    self.flush_metrics()
+                    saved = False
+                    if (self.checkpoint_config.enabled
+                            and getattr(self, "_last_ckpt_step", -1)
+                            != sched.step):
+                        self.save_checkpoint(epoch, sched.step)
+                        self._last_ckpt_step = sched.step
+                        saved = True
+                    self._preempt_saved = (
+                        saved or getattr(self, "_last_ckpt_step", -1)
+                        == sched.step)
+                    self.preempted = True
+                    self._stop_trace()  # may stop inside an open window
+                    return
             self.flush_metrics()
             # epoch-end / final checkpoint (reference is_ckpt_step's
             # last-batch clause): the generator sets its exhausted flag only
