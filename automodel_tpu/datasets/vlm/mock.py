@@ -131,9 +131,12 @@ class MockVLMProcessor:
 
 def make_mock_vlm_dataset(num_samples: int = 64, image_size: int = 32,
                           seed: int = 0, limit_dataset_samples: Optional[int] = None,
+                          desc_words: int = 5,
                           **_kw) -> List[dict]:
     """Synthetic image->description conversations in the exact sample format
-    the real builders emit (``datasets/vlm/datasets.py``)."""
+    the real builders emit (``datasets/vlm/datasets.py``).  ``desc_words``
+    sizes the assistant answer (long answers make realistic-length
+    sequences for throughput benchmarks)."""
     rng = np.random.default_rng(seed)
     n = min(num_samples, limit_dataset_samples or num_samples)
     words = ["red", "blue", "green", "cat", "dog", "car", "tree", "house",
@@ -141,7 +144,7 @@ def make_mock_vlm_dataset(num_samples: int = 64, image_size: int = 32,
     out = []
     for _ in range(n):
         img = rng.integers(0, 256, (image_size, image_size, 3)).astype(np.uint8)
-        desc = " ".join(rng.choice(words, size=5))
+        desc = " ".join(rng.choice(words, size=int(desc_words)))
         out.append({
             "conversation": [
                 {"role": "user", "content": [

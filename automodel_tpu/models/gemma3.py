@@ -497,6 +497,13 @@ class Gemma3ForConditionalGeneration:
     def flops_per_token(self) -> float:
         return self.language_model.flops_per_token()
 
+    def flops_per_image(self) -> float:
+        """Vision-tower FLOPs per image (for MFU accounting: step FLOPs =
+        text_tokens * flops_per_token + n_images * flops_per_image)."""
+        from automodel_tpu.models.vision import vision_flops_per_image
+
+        return vision_flops_per_image(self.config.vision_config)
+
 
 def _gemma3_flops_per_token(cfg: Gemma3Config) -> float:
     per_layer = (

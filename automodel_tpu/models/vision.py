@@ -44,6 +44,18 @@ class VisionConfig:
         return cls(**{k: v for k, v in hf.items() if k in known})
 
 
+def vision_flops_per_image(vc) -> float:
+    """Approximate training FLOPs per IMAGE through a SigLIP-style tower
+    (fwd+bwd = 6x matmul MACs, same convention as the text models'
+    ``flops_per_token``): attention + MLP projections per patch per layer,
+    plus the patch embedding."""
+    per_layer = (4 * vc.hidden_size ** 2
+                 + 2 * vc.hidden_size * vc.intermediate_size)
+    embed = vc.patch_size ** 2 * vc.num_channels * vc.hidden_size
+    return 3.0 * 2.0 * vc.num_patches * (
+        vc.num_hidden_layers * per_layer + embed)
+
+
 class VisionTower:
     def __init__(self, config: VisionConfig,
                  param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,

@@ -172,6 +172,11 @@ class VLMForConditionalGeneration:
     def flops_per_token(self) -> float:
         return self.language_model.flops_per_token()
 
+    def flops_per_image(self) -> float:
+        from automodel_tpu.models.vision import vision_flops_per_image
+
+        return vision_flops_per_image(self.config.vision_config)
+
 
 def build_vlm_model(config: Optional[dict] = None, **kwargs):
     if config is not None:

@@ -58,3 +58,21 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if item.module.__name__.split(".")[-1] in _SLOW_MODULES:
             item.add_marker(pytest.mark.slow)
+
+
+@pytest.fixture
+def subprocess_env():
+    """Factory: env dict for a child that must run on N virtual CPU devices
+    (forces the cpu platform past the axon sitecustomize and re-pins
+    xla_force_host_platform_device_count) — shared by every
+    subprocess-launching test so the env dance cannot drift."""
+    def make(n_devices: int):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+        return env
+    return make

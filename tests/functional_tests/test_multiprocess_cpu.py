@@ -68,18 +68,12 @@ _CHILD = textwrap.dedent("""
 
 
 @pytest.mark.slow
-def test_two_process_recipe_trains_and_checkpoints(tmp_path):
+def test_two_process_recipe_trains_and_checkpoints(tmp_path, subprocess_env):
     with socket.socket() as s:
         s.bind(("localhost", 0))
         port = s.getsockname()[1]
     root = os.path.join(os.path.dirname(__file__), "..", "..")
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    flags = [f for f in env.get("XLA_FLAGS", "").split()
-             if "xla_force_host_platform_device_count" not in f]
-    flags.append("--xla_force_host_platform_device_count=4")
-    env["XLA_FLAGS"] = " ".join(flags)
+    env = subprocess_env(4)
     ckpt = str(tmp_path / "ckpt")
     procs = [
         subprocess.Popen(
