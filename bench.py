@@ -140,6 +140,25 @@ def _secondary_main(name: str) -> None:
                      "--step_scheduler.max_steps", str(steps + warmup + 2),
                      "--dataset.num_samples", "256",
                      "--step_scheduler.num_epochs", "1000"]
+        if SMALL:
+            # shrink the 1B-class bench model to dev-host scale
+            overrides += [
+                "--model.config.text_config.hidden_size", "256",
+                "--model.config.text_config.intermediate_size", "1024",
+                "--model.config.text_config.num_hidden_layers", "4",
+                "--model.config.text_config.num_attention_heads", "8",
+                "--model.config.text_config.num_key_value_heads", "4",
+                "--model.config.text_config.head_dim", "32",
+                "--model.config.text_config.query_pre_attn_scalar", "32.0",
+                "--model.config.vision_config.hidden_size", "128",
+                "--model.config.vision_config.intermediate_size", "512",
+                "--model.config.vision_config.num_hidden_layers", "2",
+                "--model.config.vision_config.num_attention_heads", "4",
+                "--dataset.desc_words", "80",
+                "--dataloader.fixed_length", "256",
+                "--step_scheduler.global_batch_size", "2",
+                "--step_scheduler.local_batch_size", "2",
+            ]
         tps, recipe, ips = _run_recipe(FinetuneRecipeForVLM, VLM_YAML,
                                        overrides, steps, warmup)
         # MFU from BOTH towers: text tokens x decoder FLOPs/token +

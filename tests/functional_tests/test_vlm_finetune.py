@@ -184,6 +184,28 @@ def test_gemma3n_recipe_trains(tmp_path):
     assert recipe.last_metrics["loss"] < first["loss"]
 
 
+def test_gemma3n_peft_recipe_trains(tmp_path):
+    """Gemma-3n LoRA PEFT (the reference's gemma3n_vl_4b_medpix_peft.yaml
+    role at tiny scale): adapters on the language model only; loss
+    descends."""
+    from automodel_tpu.recipes.vlm.finetune import FinetuneRecipeForVLM
+
+    yaml = os.path.join(os.path.dirname(__file__), "..", "..", "examples",
+                        "vlm_finetune", "tiny_gemma3n_mock.yaml")
+    cfg = parse_args_and_load_config(
+        ["--config", yaml,
+         "--peft._target_", "automodel_tpu.peft.lora.PeftConfig",
+         "--peft.match_all_linear", "false",
+         "--peft.target_modules", "['*language_model*_proj*']",
+         "--peft.dim", "4", "--peft.alpha", "8",
+         "--step_scheduler.max_steps", "4", "--optimizer.lr", "1e-2"])
+    recipe = FinetuneRecipeForVLM(cfg).setup()
+    first = recipe._run_train_optim_step(next(iter(recipe.step_scheduler)))
+    recipe.run_train_validation_loop()
+    assert np.isfinite(recipe.last_metrics["loss"])
+    assert recipe.last_metrics["loss"] < first["loss"]
+
+
 def test_phi4_mm_recipe_trains(tmp_path):
     """Phi-4-MM audio end-to-end through the VLM recipe: the COLLATE_FNS
     dispatch routes the Phi4MMProcessor to the phi4 collator, whose audio

@@ -56,3 +56,34 @@ def test_sharded_wrapper_single_chip_mesh():
     ref = dot_product_attention(q, k, v, causal=True)
     assert float(jnp.max(jnp.abs(out.astype(jnp.float32)
                                  - ref.astype(jnp.float32)))) < 0.05
+
+
+def test_seq_alignment_padding_on_chip():
+    """Odd-128 S (the internal pad-to-256 path) vs SDPA on hardware: the
+    off-chip interpret-mode test cannot catch TPU-lowering issues in the
+    padded kernel (block geometry, fused backward over padded rows)."""
+    S_odd = 1152
+    kq, kk, kv = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(kq, (B, S_odd, Hq, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S_odd, Hk, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S_odd, Hk, D), jnp.bfloat16)
+    out = jax.jit(lambda q, k, v: splash_attention_bshd(
+        q, k, v, causal=True))(q, k, v)
+    assert out.shape == (B, S_odd, Hq, D)
+    ref = dot_product_attention(q, k, v, causal=True)
+    assert float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                 - ref.astype(jnp.float32)))) < 0.05
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            fn(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    gs = jax.jit(jax.grad(loss(splash_attention_bshd),
+                          argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss(dot_product_attention),
+                          argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(gs, gr):
+        assert a.shape == b.shape
+        scale = float(jnp.max(jnp.abs(b.astype(jnp.float32)))) + 1e-9
+        assert float(jnp.max(jnp.abs(
+            a.astype(jnp.float32) - b.astype(jnp.float32)))) / scale < 0.06
