@@ -232,11 +232,25 @@ def _qwen_special(processor) -> Dict[str, int]:
     return ids
 
 
+def _resize_square(img: Any, side: int) -> Any:
+    """Resize an image (PIL or array) to ``side x side`` — the knob that
+    lets aspect-varied datasets satisfy a pinned static grid (the qwen
+    processor preserves aspect, so without this each aspect ratio would
+    compile its own program and mixed batches would fail)."""
+    if hasattr(img, "resize") and not isinstance(img, np.ndarray):  # PIL
+        return img.resize((side, side))
+    arr = np.asarray(img)
+    yi = (np.arange(side) * arr.shape[0] // side).clip(0, arr.shape[0] - 1)
+    xi = (np.arange(side) * arr.shape[1] // side).clip(0, arr.shape[1] - 1)
+    return arr[yi][:, xi]
+
+
 def qwen2_5_collate_fn(examples: List[dict], processor,
                        start_of_response_token: str = "<|im_start|>assistant\n",
                        pad_seq_len_divisible: Optional[int] = None,
                        fixed_length: Optional[int] = None,
-                       tokens_per_second: int = 2
+                       tokens_per_second: int = 2,
+                       resize_images_to: Optional[int] = None
                        ) -> Dict[str, np.ndarray]:
     """Qwen2.5-VL: im_start/assistant response marker (reference
     ``collate_fns.py:120-148``).
@@ -257,6 +271,9 @@ def qwen2_5_collate_fn(examples: List[dict], processor,
                       max_length=int(fixed_length))
     images = _gather_images(examples)
     if images is not None:
+        if resize_images_to:
+            images = [[_resize_square(i, int(resize_images_to))
+                       for i in imgs] for imgs in images]
         kwargs["images"] = images
     videos = _gather_videos(examples)
     if videos is not None:

@@ -17,6 +17,8 @@ scale, each in its own subprocess (fresh HBM):
   * ``peft``      — LoRA fine-tune (config #2);
   * ``qlora_int8``— LoRA over the int8 weight-only base;
   * ``quant_int8``— int8 quantized COMPUTE (the reference's fp8 role);
+  * ``long_context_16k`` — 16k packed tokens per row (splash causal block
+    skipping + remat; attention-dominated, so tok/s only);
   * ``vlm``       — Gemma-3-VL scale-down (config #4: SigLIP tower +
     Gemma text decoder) at S=2048; reports ``vlm_vs_baseline`` = MFU/0.40
     with BOTH towers' FLOPs accounted.
@@ -81,6 +83,16 @@ SECONDARY = {
     "quant_int8": [
         "--fp8.enabled", "true", "--fp8.dtype", "int8",
         "--fp8.recipe_name", "tensorwise",
+    ],
+    # long-context leg: 16k packed tokens per row on one chip (splash
+    # causal block skipping + remat); tok/s is attention-dominated here —
+    # the per-token FLOPs grow ~linearly with S, which flops_per_token's
+    # matmul-only convention does not count, so no vs_baseline is claimed.
+    "long_context_16k": [
+        "--packed_sequence.packed_sequence_size", "16384",
+        "--step_scheduler.global_batch_size", "1",
+        "--step_scheduler.local_batch_size", "1",
+        "--dataset.num_sentences", "2048",
     ],
 }
 

@@ -93,6 +93,60 @@ _CHILD = textwrap.dedent("""
 """)
 
 
+_CP_CHILD = textwrap.dedent("""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    assert jax.device_count() == 8, jax.device_count()
+
+    from automodel_tpu.distributed.mesh import MeshManager
+    from automodel_tpu.distributed.shardings import build_parallel_plan
+    from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from automodel_tpu.optim import build_optimizer
+    from automodel_tpu.training.train_step import build_train_step
+
+    # Llama-3.2-1B shape; 32k context sharded seq-wise over cp=4 (ring
+    # attention) x dp=2 — the multi-chip long-context recipe
+    cfg = LlamaConfig(
+        vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+        num_hidden_layers=16, num_attention_heads=32,
+        num_key_value_heads=8, head_dim=64, rope_theta=500000.0,
+        tie_word_embeddings=True, max_position_embeddings=131072)
+    model = LlamaForCausalLM(cfg, param_dtype=jnp.bfloat16,
+                             compute_dtype=jnp.bfloat16)
+    mm = MeshManager(dp_size=2, cp_size=4, tp_size=1)
+    plan = build_parallel_plan(model, mm)
+    fns = build_train_step(model, build_optimizer(name="adamw", lr=1e-3),
+                           plan=plan, grad_dtype=jnp.bfloat16)
+    abs_params = model.abstract_params()
+    abs_opt = jax.eval_shape(fns.init_opt_state, abs_params)
+    A, B, S = 1, 2, 32768
+    abs_batch = {
+        "input_ids": jax.ShapeDtypeStruct((A, B, S), jnp.int32),
+        "labels": jax.ShapeDtypeStruct((A, B, S), jnp.int32),
+    }
+    out = jax.eval_shape(fns.train_step, abs_params, abs_opt, abs_batch)
+    assert out[2]["loss"].shape == ()
+    print("32k cp plan OK")
+""")
+
+
+def test_32k_context_cp_ring_plan_abstract_evals(subprocess_env):
+    """Long-context plan check: the 1B train step at S=32768 over a
+    dp2 x cp4 mesh (ring attention over the cp axis) abstract-evals —
+    shapes-only, since executing real 32k attention on one CPU core is
+    infeasible and the single-chip path is capped by the environment's
+    remote-compile helper at 16k (see bench.py long_context_16k)."""
+    env = subprocess_env(8)
+    root = os.path.join(os.path.dirname(__file__), "..", "..")
+    proc = subprocess.run(
+        [sys.executable, "-c", _CP_CHILD], env=env, cwd=root,
+        capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "32k cp plan OK" in proc.stdout
+
+
 def test_70b_hsdp_tp_plan_abstract_evals(subprocess_env):
     # deliberately NOT marked slow: shapes-only (eval_shape, no compile),
     # measured ~5s — virtual devices are cheap when nothing materializes

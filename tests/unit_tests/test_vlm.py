@@ -86,6 +86,31 @@ def test_collate_registry_dispatch(processor, samples):
     assert out["input_ids"].dtype == np.int32
 
 
+def test_qwen_collate_resize_images_to_squares_inputs():
+    """resize_images_to squares aspect-varied images BEFORE the processor,
+    so a pinned static grid holds across the dataset (the qwen processor
+    preserves aspect; see examples/vlm_finetune/qwen2_5_vl_3b_rdr.yaml)."""
+    from automodel_tpu.datasets.vlm.collate_fns import qwen2_5_collate_fn
+    from automodel_tpu.datasets.vlm.mock import Qwen2_5_VLProcessor
+
+    proc = Qwen2_5_VLProcessor(vocab_size=256, grid=(1, 4, 4), patch_size=4)
+    rng = np.random.default_rng(0)
+    # deliberately non-square, different aspect per sample
+    samples = [
+        {"conversation": [
+            {"role": "user", "content": [
+                {"type": "image"}, {"type": "text", "text": "what"}]},
+            {"role": "assistant", "content": [
+                {"type": "text", "text": "thing"}]}],
+         "images": [rng.integers(0, 255, (h, w, 3)).astype(np.uint8)]}
+        for h, w in ((40, 90), (120, 30))
+    ]
+    out = qwen2_5_collate_fn(samples, proc, resize_images_to=16)
+    # both images produced the single static grid's patch count
+    assert out["pixel_values"].shape[0] == 2 * 1 * 4 * 4
+    assert np.all(out["image_grid_thw"] == [1, 4, 4])
+
+
 def test_to_nhwc_conversion():
     nchw = np.zeros((2, 3, 8, 8), np.float32)
     assert to_nhwc(nchw).shape == (2, 8, 8, 3)
