@@ -38,6 +38,7 @@ class StatefulDataLoader:
         drop_last: bool = True,
         pad_seq_len_divisible: Optional[int] = None,
         host_rows: Optional[Any] = None,
+        length_bucket_pool: Optional[int] = None,
         **_unused,
     ) -> None:
         """``host_rows``: per-host input sharding — indices INTO each global
@@ -61,12 +62,19 @@ class StatefulDataLoader:
         self.pad_seq_len_divisible = pad_seq_len_divisible
         self.shuffle = shuffle
         self.seed = seed
+        self.length_bucket_pool = length_bucket_pool
         self.drop_last = drop_last
         self.epoch = 0
         self._index = 0          # samples consumed in the current epoch
         self.is_map_style = (
             hasattr(dataset, "__getitem__") and hasattr(dataset, "__len__")
             and not getattr(dataset, "streaming", False))
+        if self.length_bucket_pool and not self.is_map_style:
+            raise ValueError(
+                "length_bucket_pool needs a map-style dataset (lengths are "
+                "read ahead of batching); iterable/streaming datasets "
+                "cannot be length-bucketed")
+        self._lens = None    # per-sample lengths, cached across epochs
 
     def set_epoch(self, epoch: int) -> None:
         # Forward-only: the loader rolls itself to epoch+1 when it emits the
@@ -83,12 +91,49 @@ class StatefulDataLoader:
                 samples, pad_seq_len_divisible=self.pad_seq_len_divisible)
         return self.collate_fn(samples)
 
+    def _sample_lengths(self) -> np.ndarray:
+        """Per-sample lengths, computed ONCE and cached (lengths are static
+        across epochs; without the cache every epoch would re-materialize
+        the whole dataset just to measure it)."""
+        if self._lens is None:
+            lens = []
+            for i in range(len(self.dataset)):
+                s = self.dataset[int(i)]
+                ids = s.get("input_ids") if isinstance(s, dict) else None
+                lens.append(len(ids) if ids is not None else 0)
+            self._lens = np.asarray(lens, np.int64)
+            if not self._lens.any():
+                raise ValueError(
+                    "length_bucket_pool: no sample exposes 'input_ids' to "
+                    "measure — bucketing would silently do nothing. Use a "
+                    "dataset whose rows carry tokenized 'input_ids', or "
+                    "drop the knob")
+        return self._lens
+
     def _epoch_order(self) -> np.ndarray:
         n = len(self.dataset)
+        rng = np.random.default_rng(self.seed + self.epoch)
+        order = rng.permutation(n) if self.shuffle else np.arange(n)
+        if not self.length_bucket_pool:
+            return order
+        all_lens = self._sample_lengths()
+        pool = max(int(self.length_bucket_pool), self.batch_size)
+        full, remainder = [], []
+        for st in range(0, n, pool):
+            chunk = order[st:st + pool]
+            chunk = chunk[np.argsort(all_lens[chunk], kind="stable")]
+            for c in np.split(chunk, range(self.batch_size, len(chunk),
+                                           self.batch_size)):
+                # a sub-batch_size tail mid-order would shift every later
+                # fixed-stride batch window across sorted groups — park
+                # remainders at the END (dropped under drop_last)
+                (full if len(c) == self.batch_size else remainder).append(c)
+        # batch-granular re-shuffle so consecutive optimizer steps do not
+        # sweep monotonically through lengths (a mild curriculum bias)
         if self.shuffle:
-            rng = np.random.default_rng(self.seed + self.epoch)
-            return rng.permutation(n)
-        return np.arange(n)
+            rng.shuffle(full)
+        parts = full + remainder
+        return np.concatenate(parts) if parts else order
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         if self.is_map_style:

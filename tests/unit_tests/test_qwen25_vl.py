@@ -142,6 +142,55 @@ def test_mrope_index_matches_transformers(tmp_path):
         ours.transpose(2, 0, 1), ref_pos.numpy())
 
 
+def test_video_mrope_index_matches_transformers(tmp_path):
+    """Host-side rope-index walk for VIDEO grids (second_per_grid_ts
+    scaling incl. the HF integer-truncation quirk, mixed with a text
+    prefix and padding rows) == HF ``get_rope_index``."""
+    model = _model()
+    params = _randomized(model, jax.random.key(9))
+    hf = _export(model, params, tmp_path)
+    rng = np.random.default_rng(9)
+    t, h, w = 2, 4, 4
+    n_units = t * (h // 2) * (w // 2)
+    rows = []
+    for _ in range(2):
+        rows.append(rng.integers(1, 90, 3).tolist() + [VSTART]
+                    + [VID] * n_units + rng.integers(1, 90, 4).tolist())
+    ids = np.asarray(rows, np.int64)
+    mask = np.ones_like(ids)
+    mask[1, -2:] = 0
+    ids[1, -2:] = 0
+    vgrid = np.asarray([[t, h, w]] * 2, np.int64)
+    spg = np.asarray([0.5, 3.0], np.float64)
+    ref_pos, _ = hf.model.get_rope_index(
+        torch.from_numpy(ids), None, torch.from_numpy(vgrid),
+        torch.from_numpy(spg), attention_mask=torch.from_numpy(mask))
+    ours = qwen_mrope_position_ids(
+        ids, None, mask, spatial_merge_size=2, image_token_id=IMG,
+        video_token_id=VID, vision_start_token_id=VSTART,
+        video_grid_thw=vgrid, second_per_grid_ts=spg,
+        tokens_per_second=TINY["vision_config"]["tokens_per_second"])
+    np.testing.assert_array_equal(ours.transpose(2, 0, 1), ref_pos.numpy())
+
+
+def test_recipe_rejects_mismatched_grid():
+    """The VLM recipe's host-side grid validation: a batch whose grid_thw
+    disagrees with the model's static grid raises with the cause instead
+    of reshaping opaquely or silently training on wrong rope tables."""
+    from automodel_tpu.recipes.vlm.finetune import FinetuneRecipeForVLM
+
+    class FakeModel:
+        image_grid = (1, 4, 4)
+        video_grid = None
+
+    r = FinetuneRecipeForVLM.__new__(FinetuneRecipeForVLM)
+    r.model = FakeModel()
+    bad = {"input_ids": np.zeros((1, 8), np.int32),
+           "image_grid_thw": np.asarray([[1, 6, 4]], np.int64)}
+    with pytest.raises(ValueError, match="static grid"):
+        r._device_batch([bad])
+
+
 def test_hf_roundtrip_bitwise(tmp_path):
     from automodel_tpu.models.hf_io import load_hf_weights, save_hf_weights
 
