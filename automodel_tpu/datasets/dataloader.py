@@ -126,7 +126,10 @@ class StatefulDataLoader:
                                            self.batch_size)):
                 # a sub-batch_size tail mid-order would shift every later
                 # fixed-stride batch window across sorted groups — park
-                # remainders at the END (dropped under drop_last)
+                # remainders at the END.  Pooled remainders may recombine
+                # into a few mixed-pool tail batches (each pool's longest
+                # samples, so spreads stay moderate); only the final
+                # sub-batch_size tail is dropped under drop_last.
                 (full if len(c) == self.batch_size else remainder).append(c)
         # batch-granular re-shuffle so consecutive optimizer steps do not
         # sweep monotonically through lengths (a mild curriculum bias)

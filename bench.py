@@ -66,6 +66,9 @@ SECONDARY = {
         # stable [B, S] shape after warmup instead of a compile per bucket
         "--dataset.mean_len", "1000", "--dataset.std_len", "30",
         "--dataset.max_sentence_len", "1100",
+        # length-sorted pools (the shipped hellaswag config enables this
+        # too): nearly every batch lands on the efficient 1024 bucket
+        "--dataloader.length_bucket_pool", "256",
     ],
     "peft": [
         "--peft.target_modules", "['*_proj']",
