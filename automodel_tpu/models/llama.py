@@ -102,12 +102,25 @@ class LlamaForCausalLM:
         remat: bool = True,
         remat_policy: Optional[str] = "nothing_saveable",
         weight_only_quant: Optional[str] = None,   # "int8": QLoRA-style base
+        scan_unroll: int = 1,
+        scan_block: int = 1,
     ):
         self.config = config
         self.param_dtype = jnp.dtype(param_dtype)
         self.compute_dtype = jnp.dtype(compute_dtype)
         self.remat = remat
         self.remat_policy = remat_policy
+        # lax.scan unroll factor for the layer loop: >1 trades compile time
+        # for removing while-loop iteration overhead (and at unroll == L,
+        # the loop entirely).  Measured NEGATIVE at Llama-1B bench shapes
+        # (round 5: unroll 4 was ~7% slower, 16 OOMed) — kept as a knob.
+        self.scan_unroll = scan_unroll
+        # Layers per checkpointed scan body: block 2 halves the stacked
+        # [L, B, S, H] carried-residual memory (the backward recomputes a
+        # 2-layer window instead of 1), buying HBM for cheaper-to-save
+        # tensors like the splash attention residuals (see
+        # ``ops/splash_attention.py`` residual_checkpoint_name).
+        self.scan_block = scan_block
         self.quant = None  # set by quantization.fp8.apply_fp8_to_model
         # Weight-only quantized layer kernels (int8 + per-out-channel scale,
         # dequantized on the fly in proj) — the bitsandbytes-QLoRA role
@@ -457,7 +470,7 @@ class LlamaForCausalLM:
 
         decoding = kv_cache is not None
 
-        def body(h, xs):
+        def one_layer(h, xs):
             layer_params, ad, idx, cache = xs
             rng = (jax.random.fold_in(dropout_rng, idx)
                    if dropout_rng is not None else None)
@@ -470,13 +483,43 @@ class LlamaForCausalLM:
             )
             return h, (new_cache, aux)
 
+        L = cfg.num_hidden_layers
+        if self.scan_block < 1:
+            raise ValueError(f"model.scan_block must be >= 1, got "
+                             f"{self.scan_block}")
+        if self.scan_block > 1 and L % self.scan_block:
+            raise ValueError(
+                f"model.scan_block={self.scan_block} must divide "
+                f"num_hidden_layers={L}")
+        block = self.scan_block if not decoding else 1
+        if block == 1:
+            body = one_layer
+        else:
+            # Scan over L/block groups; the body runs `block` layers.  Only
+            # the group-boundary hidden state is carried/stacked, so the
+            # scan's saved-residual memory shrinks by `block` while the
+            # backward recomputes a block-sized window.
+            def body(h, xs):
+                ys = []
+                for i in range(block):
+                    h, y = one_layer(h, jax.tree.map(lambda a: a[i], xs))
+                    ys.append(y)
+                return h, jax.tree.map(lambda *a: jnp.stack(a), *ys)
+
         if self.remat and not decoding:
             body = jax.checkpoint(
                 body, policy=resolve_remat_policy(self.remat_policy),
                 prevent_cse=False)
+        xs = (params["layers"], layer_adapters, layer_idx, kv_cache)
+        if block > 1:
+            xs = jax.tree.map(
+                lambda a: a.reshape(L // block, block, *a.shape[1:]), xs)
         hidden, (new_cache, aux_losses) = lax.scan(
-            body, hidden,
-            (params["layers"], layer_adapters, layer_idx, kv_cache))
+            body, hidden, xs, unroll=self.scan_unroll)
+        if block > 1 and (new_cache is not None or aux_losses is not None):
+            # ys come back [L/block, block, ...] -> flatten to [L, ...]
+            new_cache, aux_losses = jax.tree.map(
+                lambda a: a.reshape(L, *a.shape[2:]), (new_cache, aux_losses))
 
         hidden = rms_norm(hidden, params["norm"]["weight"], cfg.rms_norm_eps)
         lm_kernel = (

@@ -416,10 +416,14 @@ class Qwen25VLTextModel(LlamaForCausalLM):
         sin = jnp.sin(angles)[:, :, None, :]
 
         def rot(x):
-            x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-            out = jnp.concatenate(
-                [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-            return out.astype(x.dtype)
+            # f32 math, bf16 halves out before concat (same traffic fix as
+            # ops/rotary.apply_rope — keeps the fused transpose downstream
+            # of rope on bf16 buffers).
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+            return jnp.concatenate(
+                [(x1f * cos - x2f * sin).astype(x.dtype),
+                 (x2f * cos + x1f * sin).astype(x.dtype)], axis=-1)
 
         return rot(q), rot(k)
 

@@ -58,8 +58,16 @@ def apply_rope(
     sin = jnp.sin(angles)[:, :, None, :]
 
     def rot(x):
-        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-        return out.astype(x.dtype)
+        # f32 math with the casts INSIDE each half: the concat (and any
+        # downstream layout transpose for the attention kernel) then runs on
+        # bf16 buffers.  Same numerics as computing the whole rotation in
+        # f32 and casting at the end — round-5 profiling found the f32
+        # [B, S, Hq, D] rope intermediates materialized at 2x traffic in
+        # every scan iteration (fwd + remat recompute).
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+        return jnp.concatenate(
+            [(x1f * cos - x2f * sin).astype(x.dtype),
+             (x2f * cos + x1f * sin).astype(x.dtype)], axis=-1)
 
     return rot(q), rot(k)

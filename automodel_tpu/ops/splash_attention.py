@@ -93,8 +93,14 @@ def _build_kernel(q_seq: int, kv_seq: int, q_heads_per_kv: int,
         use_fused_bwd_kernel=True,
     )
     with jax.ensure_compile_time_eval():
+        # residual_checkpoint_name tags the kernel's (out, logsumexp)
+        # residuals so a ``save_names:splash_residuals`` remat policy keeps
+        # them across the layer checkpoint: the backward then runs dq/dkv
+        # directly instead of re-running the forward kernel first (~50
+        # ms/step at Llama-1B bench shapes for ~1.1 GB of saved residuals).
         return sk.make_splash_mqa_single_device(
             mask=mask, block_sizes=sizes, attn_logits_soft_cap=soft_cap,
+            residual_checkpoint_name="splash_residuals",
             interpret=interpret)
 
 
