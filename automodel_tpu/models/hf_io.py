@@ -864,6 +864,11 @@ def save_hf_config(model, out_dir: str) -> None:
 
     cfg = model.config
     d = dataclasses.asdict(cfg)
+    # HF configs use field ABSENCE for optional ints (e.g. Phi-3's
+    # original_max_position_embeddings defaults to max_position_embeddings);
+    # an explicit null would override that default with None.
+    if d.get("original_max_position_embeddings") is None:
+        d.pop("original_max_position_embeddings", None)
     d["architectures"] = (getattr(model, "hf_architectures", None)
                           or get_family(cfg.model_type).hf_architectures)
     for k, v in getattr(model, "hf_config_extra", lambda: {})().items():

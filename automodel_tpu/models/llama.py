@@ -30,7 +30,7 @@ from automodel_tpu.ops.attention import attention
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.quant import maybe_qdot
 from automodel_tpu.ops.remat import checkpoint_name, resolve_remat_policy
-from automodel_tpu.ops.rotary import apply_rope, rope_frequencies
+from automodel_tpu.ops.rotary import apply_rope, rope_parameters
 
 
 def _stable_hash(name: str) -> int:
@@ -55,6 +55,9 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     rope_scaling: Optional[dict] = None
     max_position_embeddings: int = 131072
+    # HF Phi-3 keeps this top-level (longrope short/long switch point);
+    # llama3/yarn carry it inside rope_scaling instead.
+    original_max_position_embeddings: Optional[int] = None
     tie_word_embeddings: bool = True
     attention_bias: bool = False       # Qwen2: True
     qk_norm: bool = False              # Qwen3: True (per-head RMSNorm on q/k)
@@ -127,9 +130,53 @@ class LlamaForCausalLM:
         # (reference ``_peft/lora.py:32,308-314``), TPU-shaped: frozen base
         # weights cost 1 byte/param in HBM, adapters stay bf16/fp32.
         self.weight_only_quant = weight_only_quant
-        self.inv_freq = rope_frequencies(
-            config.head_dim, config.rope_theta, config.rope_scaling
-        )
+        self._init_rope(config.head_dim)
+
+    def _init_rope(self, rotary_dim: int) -> None:
+        """Short- and (longrope) long-context rope tables + amplitude scale.
+
+        ``longrope`` checkpoints (Phi-3-mini-128k, long Phi-4) carry two
+        per-dim rescale lists; HF switches to ``long_factor`` once the
+        sequence exceeds ``original_max_position_embeddings``.  S is static
+        under jit, so :meth:`_rope_for_len` makes the same choice at trace
+        time."""
+        cfg = self.config
+        max_pos = getattr(cfg, "max_position_embeddings", None)
+        # HF longrope threshold: the CONFIG-LEVEL original_max_position_
+        # embeddings if present, else max_position_embeddings (the
+        # rope_scaling dict's own key is not consulted — see
+        # transformers _compute_longrope_parameters).
+        orig = getattr(cfg, "original_max_position_embeddings", None)
+        self.inv_freq, self.rope_attention_scaling = rope_parameters(
+            rotary_dim, cfg.rope_theta, cfg.rope_scaling,
+            max_position_embeddings=max_pos,
+            original_max_position_embeddings=orig, seq_len=1)
+        self._rope_original_max = orig or max_pos
+        self._rope_long = None
+        rope_type = (cfg.rope_scaling or {}).get(
+            "rope_type", (cfg.rope_scaling or {}).get("type", "default"))
+        if rope_type == "longrope" and self._rope_original_max:
+            self._rope_long = rope_parameters(
+                rotary_dim, cfg.rope_theta, cfg.rope_scaling,
+                max_position_embeddings=max_pos,
+                original_max_position_embeddings=orig,
+                seq_len=self._rope_original_max + 1)
+
+    def _rope_tables(self, position_ids):
+        """(inv_freq [D/2] possibly traced, attention_scaling float).
+
+        HF's longrope switches tables when ``max(position_ids) + 1``
+        exceeds the original context length (``dynamic_rope_update``);
+        positions are runtime values here, so the same predicate selects
+        between the two static tables with a jnp.where — the attention
+        factor is identical in both regimes and stays a python float."""
+        if self._rope_long is None:
+            return jnp.asarray(self.inv_freq), self.rope_attention_scaling
+        long_inv, _ = self._rope_long
+        use_long = jnp.max(position_ids) + 1 > self._rope_original_max
+        inv = jnp.where(use_long, jnp.asarray(long_inv),
+                        jnp.asarray(self.inv_freq))
+        return inv, self.rope_attention_scaling
 
     # -- init --------------------------------------------------------------
     def init(self, key: jax.Array) -> Dict[str, Any]:
@@ -264,16 +311,17 @@ class LlamaForCausalLM:
         return axes
 
     # -- forward -----------------------------------------------------------
-    def _apply_rope(self, q, k, position_ids, inv_freq):
+    def _apply_rope(self, q, k, position_ids, inv_freq, rope_scale=1.0):
         """RoPE hook: Qwen2.5-VL overrides with multimodal 3-section rope
         (position_ids [B, S, 3])."""
-        return apply_rope(q, k, position_ids, inv_freq)
+        return apply_rope(q, k, position_ids, inv_freq,
+                          attention_scaling=rope_scale)
 
     def _decoder_layer(self, hidden, layer_params, position_ids, segment_ids,
                        attention_mask, inv_freq, adapters=None,
                        adapter_scale=1.0, adapter_dropout=0.0,
                        dropout_position="post", dropout_rng=None,
-                       kv_cache=None, cache_index=None):
+                       kv_cache=None, cache_index=None, rope_scale=1.0):
         cfg = self.config
         B, S, H = hidden.shape
         D, Hq, Hk = cfg.head_dim, cfg.num_attention_heads, cfg.num_key_value_heads
@@ -324,7 +372,7 @@ class LlamaForCausalLM:
         if cfg.qk_norm:
             q = rms_norm(q, p["self_attn"]["q_norm"]["weight"], cfg.rms_norm_eps)
             k = rms_norm(k, p["self_attn"]["k_norm"]["weight"], cfg.rms_norm_eps)
-        q, k = self._apply_rope(q, k, position_ids, inv_freq)
+        q, k = self._apply_rope(q, k, position_ids, inv_freq, rope_scale)
         new_cache = None
         if kv_cache is not None:
             # Autoregressive decode: write this step's k/v into the static
@@ -457,7 +505,7 @@ class LlamaForCausalLM:
                 jnp.arange(S, dtype=jnp.int32), (B, S))
         hidden = constrain(hidden.astype(self.compute_dtype),
                            ("act_batch", "act_seq", "act_embed"))
-        inv_freq = jnp.asarray(self.inv_freq)
+        inv_freq, rope_scale = self._rope_tables(position_ids)
 
         # LoRA adapters are stacked [L, ...] like the base layer params:
         # strip the "layers." prefix and scan them alongside.
@@ -480,6 +528,7 @@ class LlamaForCausalLM:
                 adapter_dropout=adapter_dropout,
                 dropout_position=adapter_dropout_position, dropout_rng=rng,
                 kv_cache=cache, cache_index=cache_index,
+                rope_scale=rope_scale,
             )
             return h, (new_cache, aux)
 

@@ -9,9 +9,11 @@ Phi-4-multimodal (``models/phi4_mm.py``: fused ``qkv_proj`` /
 residual order) — this module registers it as a standalone text family so
 ``microsoft/phi-4`` / Phi-3-mini checkpoints load without the audio tower.
 
-Rope scope: standard rope (+ optional ``partial_rotary_factor``); the
-``longrope`` scaling of the 128k variants is not implemented and fails
-loudly in ``rope_frequencies``.
+Rope scope: standard rope, ``partial_rotary_factor``, and the ``longrope``
+scaling of the 128k variants (short/long per-dim rescale lists + the
+sqrt-log attention factor, switched on runtime positions exactly like HF's
+``dynamic_rope_update`` — see ``ops/rotary.rope_parameters`` and
+``LlamaForCausalLM._rope_tables``).
 """
 
 from __future__ import annotations

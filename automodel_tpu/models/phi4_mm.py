@@ -46,7 +46,6 @@ from jax import lax
 from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from automodel_tpu.ops.attention import attention
 from automodel_tpu.ops.norms import layer_norm, rms_norm
-from automodel_tpu.ops.rotary import rope_frequencies
 
 
 # ---------------------------------------------------------------------------
@@ -392,8 +391,10 @@ class Phi4MMTextModel(LlamaForCausalLM):
         super().__init__(config, **kwargs)
         rotary_dim = int(config.head_dim
                          * getattr(config, "partial_rotary_factor", 1.0))
-        self.inv_freq = rope_frequencies(
-            rotary_dim, config.rope_theta, config.rope_scaling)
+        # Re-derive the rope tables at the (possibly partial) rotary dim;
+        # handles longrope (Phi-3-mini-128k / long Phi-4) via the base
+        # class's short/long table pair.
+        self._init_rope(rotary_dim)
         self._rotary_dim = rotary_dim
 
     def _init_ffn(self, keys, dense):
@@ -428,14 +429,18 @@ class Phi4MMTextModel(LlamaForCausalLM):
             "o_proj": {"kernel": ("layers", "heads", "embed")}}
         return axes
 
-    def _apply_rope(self, q, k, position_ids, inv_freq):
+    def _apply_rope(self, q, k, position_ids, inv_freq, rope_scale=1.0):
         from automodel_tpu.ops.rotary import apply_rope
 
         rd = self._rotary_dim
         if rd == q.shape[-1]:
-            return apply_rope(q, k, position_ids, inv_freq)
+            return apply_rope(q, k, position_ids, inv_freq,
+                              attention_scaling=rope_scale)
+        # Partial rotary: HF scales only the rotated channels (the pass-
+        # through tail is concatenated unscaled).
         q_rot, k_rot = apply_rope(q[..., :rd], k[..., :rd],
-                                  position_ids, inv_freq)
+                                  position_ids, inv_freq,
+                                  attention_scaling=rope_scale)
         return (jnp.concatenate([q_rot, q[..., rd:]], axis=-1),
                 jnp.concatenate([k_rot, k[..., rd:]], axis=-1))
 
@@ -443,7 +448,7 @@ class Phi4MMTextModel(LlamaForCausalLM):
                        attention_mask, inv_freq, adapters=None,
                        adapter_scale=1.0, adapter_dropout=0.0,
                        dropout_position="post", dropout_rng=None,
-                       kv_cache=None, cache_index=None):
+                       kv_cache=None, cache_index=None, rope_scale=1.0):
         cfg = self.config
         B, S, H = hidden.shape
         D, Hq, Hk = cfg.head_dim, cfg.num_attention_heads, cfg.num_key_value_heads
@@ -467,7 +472,7 @@ class Phi4MMTextModel(LlamaForCausalLM):
         q = qkv[..., :Hq * D].reshape(B, S, Hq, D)
         k = qkv[..., Hq * D:(Hq + Hk) * D].reshape(B, S, Hk, D)
         v = qkv[..., (Hq + Hk) * D:].reshape(B, S, Hk, D)
-        q, k = self._apply_rope(q, k, position_ids, inv_freq)
+        q, k = self._apply_rope(q, k, position_ids, inv_freq, rope_scale)
         new_cache = None
         if kv_cache is not None:
             from automodel_tpu.ops.attention import cached_attention

@@ -400,11 +400,12 @@ class Qwen25VLTextModel(LlamaForCausalLM):
             off += n
         self._mrope_sel = sel                       # [3, half] one-hot
 
-    def _apply_rope(self, q, k, position_ids, inv_freq):
+    def _apply_rope(self, q, k, position_ids, inv_freq, rope_scale=1.0):
         if position_ids.ndim == 2:
             from automodel_tpu.ops.rotary import apply_rope
 
-            return apply_rope(q, k, position_ids, inv_freq)
+            return apply_rope(q, k, position_ids, inv_freq,
+                              attention_scaling=rope_scale)
         # [B, S, 3] -> per-channel section select (HF
         # apply_multimodal_rotary_pos_emb: first half channels split into
         # t/h/w blocks, second half mirrors)
@@ -412,8 +413,8 @@ class Qwen25VLTextModel(LlamaForCausalLM):
                    * inv_freq[None, None, None, :])          # [B, S, 3, half]
         angles = jnp.einsum("bsth,th->bsh", angles3,
                             jnp.asarray(self._mrope_sel))
-        cos = jnp.cos(angles)[:, :, None, :]
-        sin = jnp.sin(angles)[:, :, None, :]
+        cos = jnp.cos(angles)[:, :, None, :] * rope_scale
+        sin = jnp.sin(angles)[:, :, None, :] * rope_scale
 
         def rot(x):
             # f32 math, bf16 halves out before concat (same traffic fix as
