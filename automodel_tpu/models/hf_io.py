@@ -55,11 +55,16 @@ class HfSpec:
                  load_transform: Optional[Callable] = None,
                  save_transform: Optional[Callable] = None,
                  column_transform: Optional[Callable] = None,
-                 missing_init: Optional[Callable] = None):
+                 missing_init: Optional[Callable] = None,
+                 layer_offset: int = 0):
         self.template = template
         self.stacked = stacked
         self.expert_stacked = expert_stacked
         self.transpose = transpose
+        # Stack position 0 maps to HF layer index ``layer_offset`` — for
+        # families whose layer stack is split into heterogeneous sub-stacks
+        # (DeepSeek first_k_dense_replace: dense layers [0, k), MoE [k, L)).
+        self.layer_offset = layer_offset
         self.load_transform = load_transform
         self.save_transform = save_transform
         # Column-local load transform for 2-D torch-Linear tensors: receives
@@ -134,6 +139,64 @@ def qwen3_moe_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
         m[("layers", "mlp", "experts", proj, "kernel")] = HfSpec(
             f"model.layers.{{i}}.mlp.experts.{{e}}.{proj}.weight",
             stacked=True, expert_stacked=True, transpose=True)
+    return m
+
+
+def deepseek_v3_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    """DeepSeek-V2/V3 (HF ``DeepseekV3ForCausalLM`` naming): MLA attention
+    projections plus the split dense/MoE layer stacks.  HF layer ``i`` maps
+    to ``dense_layers[i]`` for ``i < first_k_dense_replace`` and to
+    ``layers[i - first_k_dense_replace]`` after (``layer_offset``)."""
+    kd = config.first_k_dense_replace
+    n_moe = config.num_hidden_layers - kd
+    m: Dict[Tuple[str, ...], HfSpec] = {
+        ("embed_tokens", "embedding"): HfSpec("model.embed_tokens.weight"),
+        ("norm", "weight"): HfSpec("model.norm.weight"),
+    }
+    if not config.tie_word_embeddings:
+        m[("lm_head", "kernel")] = HfSpec("lm_head.weight", transpose=True)
+
+    def attn_and_norms(stack: str, off: int):
+        for norm in ("input_layernorm", "post_attention_layernorm"):
+            m[(stack, norm, "weight")] = HfSpec(
+                f"model.layers.{{i}}.{norm}.weight", stacked=True,
+                layer_offset=off)
+        projs = (("q_proj",) if config.q_lora_rank is None
+                 else ("q_a_proj", "q_b_proj"))
+        for proj in projs + ("kv_a_proj_with_mqa", "kv_b_proj", "o_proj"):
+            m[(stack, "self_attn", proj, "kernel")] = HfSpec(
+                f"model.layers.{{i}}.self_attn.{proj}.weight", stacked=True,
+                transpose=True, layer_offset=off)
+        norms = (("kv_a_layernorm",) if config.q_lora_rank is None
+                 else ("q_a_layernorm", "kv_a_layernorm"))
+        for norm in norms:
+            m[(stack, "self_attn", norm, "weight")] = HfSpec(
+                f"model.layers.{{i}}.self_attn.{norm}.weight", stacked=True,
+                layer_offset=off)
+
+    if kd:
+        attn_and_norms("dense_layers", 0)
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            m[("dense_layers", "mlp", proj, "kernel")] = HfSpec(
+                f"model.layers.{{i}}.mlp.{proj}.weight", stacked=True,
+                transpose=True)
+    if n_moe:
+        attn_and_norms("layers", kd)
+        m[("layers", "mlp", "gate", "kernel")] = HfSpec(
+            "model.layers.{i}.mlp.gate.weight", stacked=True, transpose=True,
+            layer_offset=kd)
+        m[("layers", "mlp", "gate", "e_score_correction_bias")] = HfSpec(
+            "model.layers.{i}.mlp.gate.e_score_correction_bias", stacked=True,
+            layer_offset=kd,
+            missing_init=lambda shape, dtype: np.zeros(shape, dtype))
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            m[("layers", "mlp", "experts", proj, "kernel")] = HfSpec(
+                f"model.layers.{{i}}.mlp.experts.{{e}}.{proj}.weight",
+                stacked=True, expert_stacked=True, transpose=True,
+                layer_offset=kd)
+            m[("layers", "mlp", "shared_experts", proj, "kernel")] = HfSpec(
+                f"model.layers.{{i}}.mlp.shared_experts.{proj}.weight",
+                stacked=True, transpose=True, layer_offset=kd)
     return m
 
 
@@ -605,9 +668,16 @@ class _LazyCheckpoint:
 
 def _hf_slice(spec: HfSpec, layer: Optional[int], idx: Tuple[slice, ...],
               ckpt: _LazyCheckpoint, dtype,
-              expert: Optional[int] = None) -> np.ndarray:
-    key = (spec.template.format(i=layer, e=expert) if spec.stacked
-           else spec.template)
+              expert: Optional[int] = None,
+              sub_shape: Optional[Tuple[int, ...]] = None) -> np.ndarray:
+    key = (spec.template.format(
+        i=None if layer is None else layer + spec.layer_offset, e=expert)
+        if spec.stacked else spec.template)
+    if (spec.missing_init is not None and sub_shape is not None
+            and key not in ckpt):
+        # per-layer fallback for stacked specs (e.g. a DeepSeek checkpoint
+        # without e_score_correction_bias tensors)
+        return np.asarray(spec.missing_init(sub_shape, dtype))[idx]
     if spec.column_transform is not None:
         in_sl, out_sl = idx[-2], idx[-1]
         # HF stores (out, in): reading (out_slice, :) is a contiguous
@@ -663,7 +733,8 @@ def load_hf_weights(
                 e0, e1, _ = idx[1].indices(shape[1])
                 return np.stack([
                     np.stack([
-                        _hf_slice(spec, i, idx[2:], ckpt, dtype, expert=e)
+                        _hf_slice(spec, i, idx[2:], ckpt, dtype, expert=e,
+                                  sub_shape=shape[2:])
                         for e in range(e0, e1)
                     ], axis=0)
                     for i in range(l0, l1)
@@ -672,7 +743,8 @@ def load_hf_weights(
                 lsl = idx[0]
                 start, stop, _ = lsl.indices(shape[0])
                 parts = [
-                    _hf_slice(spec, i, idx[1:], ckpt, dtype)
+                    _hf_slice(spec, i, idx[1:], ckpt, dtype,
+                              sub_shape=shape[1:])
                     for i in range(start, stop)
                 ]
                 return np.stack(parts, axis=0)
@@ -746,14 +818,16 @@ def save_hf_weights(
                 for e in range(value.shape[1]):
                     def expert_fn(v=value, i=i, e=e, spec=spec):
                         return to_hf(materialize(v[i][e]), spec)
-                    entries.append((spec.template.format(i=i, e=e),
-                                    per_expert, expert_fn))
+                    entries.append(
+                        (spec.template.format(i=i + spec.layer_offset, e=e),
+                         per_expert, expert_fn))
         elif spec.stacked:
             per_layer = int(np.prod(value.shape[1:])) * itemsize
             for i in range(value.shape[0]):
                 def layer_fn(v=value, i=i, spec=spec):
                     return to_hf(materialize(v[i]), spec)
-                entries.append((spec.template.format(i=i), per_layer, layer_fn))
+                entries.append((spec.template.format(i=i + spec.layer_offset),
+                                per_layer, layer_fn))
         else:
             def full_fn(v=value, spec=spec):
                 return to_hf(materialize(v), spec)

@@ -145,3 +145,12 @@ def _ensure_builtin() -> None:
                                Gemma3nForConditionalGeneration,
                                hf_io.gemma3n_vlm_key_map,
                                ["Gemma3nForConditionalGeneration"]))
+    from automodel_tpu.models.deepseek_v3 import (
+        DeepseekV3Config,
+        DeepseekV3ForCausalLM,
+    )
+
+    register_model(ModelFamily("deepseek_v3", DeepseekV3Config,
+                               DeepseekV3ForCausalLM,
+                               hf_io.deepseek_v3_key_map,
+                               ["DeepseekV3ForCausalLM"]))

@@ -77,6 +77,19 @@ def _group_size(tokens: int, requested: int) -> int:
     return m
 
 
+def group_and_capacity(tokens: int, group_size: int, num_experts: int,
+                       k: int, capacity_factor: Optional[float]
+                       ) -> Tuple[int, int]:
+    """(tokens-per-group M, per-group expert capacity C) for the dispatch
+    tensors.  ``capacity_factor=None`` -> lossless (C = M)."""
+    M = _group_size(tokens, group_size)
+    if capacity_factor is None:
+        return M, M
+    C = min(M, max(int(math.ceil(k * M / num_experts
+                                 * float(capacity_factor))), 1))
+    return M, C
+
+
 def moe_mlp_block(
     x: jnp.ndarray,                 # [B, S, H]
     gate_kernel: jnp.ndarray,       # [H, E]
@@ -104,12 +117,8 @@ def moe_mlp_block(
     k = int(num_experts_per_tok)
     cd = compute_dtype
     T = B * S
-    M = _group_size(T, group_size)
+    M, C = group_and_capacity(T, group_size, E, k, capacity_factor)
     G = T // M
-    if capacity_factor is None:
-        C = M
-    else:
-        C = min(M, max(int(math.ceil(k * M / E * float(capacity_factor))), 1))
 
     xg = x.reshape(G, M, H)
     # Token dim gathers every batch-ish mesh axis (dp x cp): routing is
@@ -121,6 +130,30 @@ def moe_mlp_block(
     weights, idx, probs = topk_routing(router_logits, k,
                                        norm_topk=norm_topk)     # [G, M, k]
     aux = routing_stats(probs, idx, E)
+    out = expert_dispatch_ffn(xg, weights, idx, w_gate, w_up, w_down,
+                              capacity=C, compute_dtype=cd)
+    return out.reshape(B, S, H), aux
+
+
+def expert_dispatch_ffn(
+    xg: jnp.ndarray,          # [G, M, H] grouped tokens
+    weights: jnp.ndarray,     # [G, M, k] combine weights
+    idx: jnp.ndarray,         # [G, M, k] expert assignment
+    w_gate: jnp.ndarray,      # [E, H, I]
+    w_up: jnp.ndarray,        # [E, H, I]
+    w_down: jnp.ndarray,      # [E, I, H]
+    *,
+    capacity: int,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> jnp.ndarray:
+    """Static-shape dispatch/combine + expert-batched SwiGLU FFN (the
+    routing-agnostic core shared by Mixtral softmax-top-k and DeepSeek
+    sigmoid no-aux routing)."""
+    G, M, H = xg.shape
+    E = w_gate.shape[0]
+    k = idx.shape[-1]
+    C = capacity
+    cd = compute_dtype
 
     # Dispatch/combine build, slot-major priority (GShard): slot j's
     # assignments claim capacity after all slots < j.
@@ -144,5 +177,43 @@ def moe_mlp_block(
     h_act = jax.nn.silu(h_gate) * h_up
     expert_out = jnp.einsum("egci,eih->egch", h_act, w_down.astype(cd))
     expert_out = constrain(expert_out, ("experts", "act_tokens", None, None))
-    out = jnp.einsum("egch,gmec->gmh", expert_out, combine)
-    return out.reshape(B, S, H), aux
+    return jnp.einsum("egch,gmec->gmh", expert_out, combine)
+
+
+def noaux_topk_routing(
+    scores: jnp.ndarray,      # [..., E] f32 sigmoid scores
+    bias: jnp.ndarray,        # [E] e_score_correction_bias (selection only)
+    k: int,
+    *,
+    n_group: int = 1,
+    topk_group: int = 1,
+    norm_topk: bool = True,
+    routed_scaling_factor: float = 1.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """DeepSeek-V3 aux-loss-free router (HF ``DeepseekV3TopkRouter``).
+
+    The correction bias shifts SELECTION only; combine weights gather from
+    the raw sigmoid scores (so the bias carries no gradient path, matching
+    HF's ``@torch.no_grad`` index computation).  Group-limited routing:
+    per-group score = sum of its top-2 biased scores, only the top
+    ``topk_group`` groups stay eligible (the rest masked to 0.0 exactly as
+    HF ``masked_fill(..., 0.0)`` — NOT -inf, preserving tie behavior with
+    negative biased scores).
+
+    Returns ``(weights [..., k] scaled, idx [..., k])``.
+    """
+    E = scores.shape[-1]
+    biased = scores + bias.astype(scores.dtype)
+    if n_group > 1:
+        gs = biased.reshape(*biased.shape[:-1], n_group, E // n_group)
+        group_score = jnp.sum(lax.top_k(gs, 2)[0], axis=-1)   # [..., n_group]
+        _, gidx = lax.top_k(group_score, topk_group)
+        gmask = jnp.sum(
+            jax.nn.one_hot(gidx, n_group, dtype=scores.dtype), axis=-2)
+        biased = jnp.where(gmask[..., :, None] > 0, gs, 0.0).reshape(
+            biased.shape)
+    _, idx = lax.top_k(biased, k)
+    weights = jnp.take_along_axis(scores, idx, axis=-1)
+    if norm_topk:
+        weights = weights / (jnp.sum(weights, axis=-1, keepdims=True) + 1e-20)
+    return weights * routed_scaling_factor, idx

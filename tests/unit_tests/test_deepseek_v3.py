@@ -1,0 +1,168 @@
+"""DeepSeek-V3 (MLA + no-aux MoE) HF parity — VERDICT r4 "next round" #3.
+
+Same harness as ``test_new_text_families.py``: tiny randomly-initialized
+native model -> consolidated HF repo -> ``transformers`` fp32 reload ->
+logits/loss agreement.  Cases cover both MLA query paths (plain q_proj and
+the q_lora low-rank pair), the dense/MoE layer split
+(``first_k_dense_replace``), group-limited routing, and yarn rope with the
+DeepSeek mscale attention scale.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from automodel_tpu.loss.masked_ce import cross_entropy_sum
+from automodel_tpu.models.deepseek_v3 import (
+    DeepseekV3Config,
+    DeepseekV3ForCausalLM,
+)
+from automodel_tpu.ops.moe import noaux_topk_routing
+
+
+def _base_cfg(**kw):
+    kw.setdefault("vocab_size", 256)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("num_hidden_layers", 3)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("num_key_value_heads", 4)
+    kw.setdefault("rope_theta", 10000.0)
+    kw.setdefault("tie_word_embeddings", False)
+    kw.setdefault("max_position_embeddings", 64)
+    kw.setdefault("kv_lora_rank", 32)
+    kw.setdefault("qk_rope_head_dim", 8)
+    kw.setdefault("qk_nope_head_dim", 16)
+    kw.setdefault("v_head_dim", 16)
+    kw.setdefault("n_routed_experts", 8)
+    kw.setdefault("num_experts_per_tok", 2)
+    kw.setdefault("n_shared_experts", 1)
+    kw.setdefault("moe_intermediate_size", 48)
+    kw.setdefault("first_k_dense_replace", 1)
+    kw.setdefault("moe_capacity_factor", None)   # lossless: exact parity
+    return DeepseekV3Config(**kw)
+
+
+CASES = {
+    "q_full": lambda: _base_cfg(q_lora_rank=None),
+    "q_lora_grouped": lambda: _base_cfg(
+        q_lora_rank=24, n_group=4, topk_group=2,
+        routed_scaling_factor=2.5),
+    "yarn_rope": lambda: _base_cfg(
+        q_lora_rank=24,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "beta_fast": 32.0, "beta_slow": 1.0,
+                      "mscale": 1.0, "mscale_all_dim": 1.0,
+                      "original_max_position_embeddings": 16}),
+}
+
+
+def _randomized(model, key):
+    params = model.init(key)
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(jax.random.fold_in(key, 7), len(leaves))
+    leaves = [
+        (l + 0.05 * jax.random.normal(k, l.shape, jnp.float32)).astype(l.dtype)
+        for l, k in zip(leaves, keys)
+    ]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def _export(model, params, path):
+    from automodel_tpu.models.hf_io import save_hf_weights
+
+    save_hf_weights(model, params, str(path))
+    cfg_path = os.path.join(str(path), "config.json")
+    with open(cfg_path) as f:
+        d = json.load(f)
+    d.update(pad_token_id=0, bos_token_id=1, eos_token_id=2)
+    with open(cfg_path, "w") as f:
+        json.dump(d, f, indent=2, default=str)
+    hf = transformers.AutoModelForCausalLM.from_pretrained(
+        str(path), torch_dtype=torch.float32, attn_implementation="eager")
+    hf.eval()
+    return hf
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_logits_and_loss_match_transformers(name, tmp_path):
+    cfg = CASES[name]()
+    model = DeepseekV3ForCausalLM(cfg, param_dtype=jnp.float32,
+                                  compute_dtype=jnp.float32, remat=False)
+    params = _randomized(model, jax.random.key(0))
+    hf = _export(model, params, tmp_path)
+
+    rng = np.random.default_rng(0)
+    B, S = 2, 24
+    input_ids = rng.integers(3, cfg.vocab_size, (B, S), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(input_ids)).logits.numpy()
+    out = model(params, jnp.asarray(input_ids.astype(np.int32)))
+    logits = np.asarray(out["logits"], dtype=np.float32)
+    np.testing.assert_allclose(logits, hf_logits, atol=3e-4, rtol=3e-3)
+
+    labels = jnp.asarray(input_ids.astype(np.int32))
+    loss = cross_entropy_sum(jnp.asarray(logits), labels) / labels.size
+    hf_loss = torch.nn.functional.cross_entropy(
+        torch.from_numpy(hf_logits).reshape(-1, cfg.vocab_size),
+        torch.from_numpy(input_ids).reshape(-1))
+    assert float(loss) == pytest.approx(float(hf_loss), rel=1e-4)
+
+
+def test_noaux_router_matches_hf():
+    """Router-only parity against HF DeepseekV3TopkRouter on random scores."""
+    from transformers.models.deepseek_v3.modeling_deepseek_v3 import (
+        DeepseekV3TopkRouter,
+    )
+
+    cfg = transformers.DeepseekV3Config(
+        hidden_size=32, n_routed_experts=16, num_experts_per_tok=4,
+        n_group=4, topk_group=2, norm_topk_prob=True,
+        routed_scaling_factor=2.5)
+    router = DeepseekV3TopkRouter(cfg)
+    rng = np.random.default_rng(1)
+    with torch.no_grad():
+        router.weight.copy_(torch.from_numpy(
+            rng.normal(size=(16, 32)).astype(np.float32)))
+        router.e_score_correction_bias.copy_(torch.from_numpy(
+            rng.normal(size=(16,)).astype(np.float32) * 0.5))
+    x = rng.normal(size=(6, 32)).astype(np.float32)
+    with torch.no_grad():
+        hf_idx, hf_w = router(torch.from_numpy(x))
+    scores = jax.nn.sigmoid(
+        jnp.asarray(x) @ jnp.asarray(router.weight.detach().numpy()).T)
+    w, idx = noaux_topk_routing(
+        scores, jnp.asarray(router.e_score_correction_bias.numpy()), 4,
+        n_group=4, topk_group=2, norm_topk=True, routed_scaling_factor=2.5)
+    # top-k order may differ (HF uses sorted=False): compare as sets with
+    # weights attached
+    for t in range(6):
+        ours = sorted(zip(np.asarray(idx)[t], np.asarray(w)[t]))
+        hfs = sorted(zip(hf_idx.numpy()[t], hf_w.numpy()[t]))
+        for (i1, w1), (i2, w2) in zip(ours, hfs):
+            assert i1 == i2
+            assert w1 == pytest.approx(w2, rel=1e-5)
+
+
+def test_hf_roundtrip_bitwise(tmp_path):
+    """save -> load_hf_weights restores the exact tree (layer_offset map)."""
+    from automodel_tpu.models.hf_io import load_hf_weights, save_hf_weights
+
+    cfg = _base_cfg(q_lora_rank=24)
+    model = DeepseekV3ForCausalLM(cfg, param_dtype=jnp.float32,
+                                  compute_dtype=jnp.float32)
+    params = _randomized(model, jax.random.key(2))
+    save_hf_weights(model, params, str(tmp_path))
+    restored = load_hf_weights(model, str(tmp_path))
+    flat1 = jax.tree.leaves(params)
+    flat2 = jax.tree.leaves(restored)
+    assert len(flat1) == len(flat2)
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
