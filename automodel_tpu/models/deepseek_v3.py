@@ -30,8 +30,8 @@ TPU shape:
 ``e_score_correction_bias`` is carried as a parameter for checkpoint
 round-trip but has NO gradient path (selection-only, matching HF's
 ``@torch.no_grad`` top-k); DeepSeek updates it with a separate balancing
-rule, not SGD — exclude it from weight decay via param_groups if training
-long.
+rule, not SGD — ``optim/builder.py`` excludes it from weight decay by
+leaf name so standard AdamW configs cannot silently decay it.
 
 Scope notes: rope is yarn (``ops/rotary.rope_parameters``) with the
 DeepSeek interleaved channel layout (``rope_interleave: true`` —

@@ -65,6 +65,24 @@ def _scale_by_tree(mults) -> optax.GradientTransformation:
     return optax.GradientTransformation(init, update)
 
 
+# Leaves that look like parameters but must NEVER receive weight decay:
+# DeepSeek's e_score_correction_bias is a selection-only routing bias HF
+# treats as a frozen buffer (zero gradient path) — decoupled decay would
+# silently drag it to 0 and shift expert selection.
+_NO_WEIGHT_DECAY_LEAF_NAMES = ("e_score_correction_bias",)
+
+
+def _decay_mask_fn(params):
+    import jax as _jax
+
+    def keep(path, _leaf):
+        return not any(
+            getattr(k, "key", getattr(k, "name", None))
+            in _NO_WEIGHT_DECAY_LEAF_NAMES for k in path)
+
+    return _jax.tree_util.tree_map_with_path(keep, params)
+
+
 def _scale_wd(weight_decay, wd_mults) -> optax.GradientTransformation:
     """``add_decayed_weights`` with a static per-leaf multiplier on the
     (injected, traced) base weight decay."""
@@ -131,7 +149,15 @@ def build_optimizer(
                   for g in param_groups]
         lr_t, wd_t, any_lr, any_wd = _group_multipliers(groups, params)
         lr_mults = lr_t if any_lr else None
-        wd_mults = wd_t if any_wd else None
+        if any_wd:
+            import jax as _jax
+
+            # compose the no-decay leaf exclusions into the multiplier tree
+            wd_mults = _jax.tree.map(
+                lambda m, keep: m if keep else 0.0,
+                wd_t, _decay_mask_fn(params))
+        else:
+            wd_mults = None
 
     @optax.inject_hyperparams
     def make(learning_rate, weight_decay):
@@ -145,7 +171,8 @@ def build_optimizer(
                 if wd_mults is not None:
                     chain.append(_scale_wd(weight_decay, wd_mults))
                 else:
-                    chain.append(optax.add_decayed_weights(weight_decay))
+                    chain.append(optax.add_decayed_weights(
+                        weight_decay, mask=_decay_mask_fn))
         elif name == "sgd":
             # torch.optim.SGD couples wd into the gradient *before* the
             # momentum buffer (d_p += wd*p, then buf = m*buf + d_p).
@@ -153,7 +180,8 @@ def build_optimizer(
                 if wd_mults is not None:
                     chain.append(_scale_wd(weight_decay, wd_mults))
                 else:
-                    chain.append(optax.add_decayed_weights(weight_decay))
+                    chain.append(optax.add_decayed_weights(
+                        weight_decay, mask=_decay_mask_fn))
             if momentum:
                 chain.append(optax.trace(decay=float(momentum)))
         elif name == "adafactor":

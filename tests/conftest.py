@@ -36,7 +36,8 @@ _SLOW_MODULES = {
     "test_hf_parity", "test_gemma3_parity", "test_gemma3n",
     "test_new_text_families", "test_qwen25_vl", "test_phi4_mm",
     "test_mixtral", "test_hf_io", "test_sequence_classification",
-    "test_generation", "test_models",
+    "test_generation", "test_models", "test_deepseek_v3",
+    "test_rope_scaling",
     # end-to-end recipe / multi-process tiers
     "test_train_ft_recipe", "test_vlm_finetune", "test_cli",
     "test_multiprocess_cpu", "test_checkpoint_resume", "test_pretrain",
@@ -46,6 +47,7 @@ _SLOW_MODULES = {
     # heavy sharded-step compiles
     "test_training", "test_host_sharded_input", "test_ref_yaml_recipe",
     "test_pretrain_recipe", "test_train_parity_torch", "test_peft",
+    "test_mesh_reshape_restore",
 }
 
 

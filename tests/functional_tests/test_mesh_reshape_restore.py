@@ -1,0 +1,128 @@
+"""Mesh-reshape checkpoint restore (VERDICT r4 "next round" #4).
+
+A preempted-pod resume rarely comes back on the same topology: save on
+dp4 x tp2, restore on dp2 x tp4 — or on half the devices.  The reference
+gets this from DCP resharding (``checkpoint/_backports/default_planner.py``);
+here Orbax stores GLOBAL arrays, so a restore against abstract values
+carrying the NEW mesh's NamedShardings reads exactly the byte ranges each
+device needs.  These tests prove the property end to end: train on mesh A,
+checkpoint (model + optimizer), restore on meshes of different layout and
+different device count, and the next optimizer step's loss must match the
+uninterrupted run bit-for-bit-close.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.checkpoint import checkpointing as ckpt
+from automodel_tpu.distributed.mesh import MeshManager
+from automodel_tpu.distributed.shardings import build_parallel_plan
+from automodel_tpu.loss.masked_ce import IGNORE_INDEX
+from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from automodel_tpu.optim import build_optimizer
+from automodel_tpu.training.train_step import build_train_step
+
+
+def _model():
+    return LlamaForCausalLM(
+        LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, rope_theta=10000.0,
+            tie_word_embeddings=True),
+        param_dtype=jnp.float32, compute_dtype=jnp.float32)
+
+
+def _batch():
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 255, (1, 8, 32))       # [A, B, S]
+    labels = np.roll(ids, -1, -1)
+    labels[..., -1] = IGNORE_INDEX
+    return {"input_ids": jnp.asarray(ids, jnp.int32),
+            "labels": jnp.asarray(labels, jnp.int32)}
+
+
+def _setup(mm, model):
+    plan = build_parallel_plan(model, mm)
+    tx = build_optimizer(name="adamw", lr=1e-2, weight_decay=0.01)
+    fns = build_train_step(model, tx, plan=plan)
+    params = plan.shard_params(model.init(jax.random.key(0)))
+    return fns, params, fns.init_opt_state(params)
+
+
+def _abstract_sharded(tree, shardings):
+    return jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        tree, shardings)
+
+
+@pytest.mark.parametrize("target", ["dp2_tp4", "subset_4dev"])
+def test_restore_on_reshaped_mesh_resumes_identically(tmp_path, target):
+    model = _model()
+    batch = _batch()
+    mdir = str(tmp_path / "model")
+    odir = str(tmp_path / "optim")
+    orbax_cfg = ckpt.CheckpointingConfig(model_save_format="orbax",
+                                         save_consolidated=False)
+
+    # --- mesh A: dp4 x tp2 — train 2 steps, checkpoint, then 1 more step
+    mm_a = MeshManager(dp_size=4, tp_size=2)
+    fns_a, params, opt_state = _setup(mm_a, model)
+    b_a = jax.device_put(batch, fns_a.microbatch_sharding)
+    for _ in range(2):
+        params, opt_state, _ = fns_a.train_step(params, opt_state, b_a)
+    ckpt.save_model(model, params, mdir, orbax_cfg)
+    ckpt.save_optimizer(opt_state, odir)
+    _, _, ref_metrics = fns_a.train_step(params, opt_state, b_a)
+    ref_loss = float(ref_metrics["loss"])
+
+    # --- mesh B: different layout / different device count
+    if target == "dp2_tp4":
+        mm_b = MeshManager(dp_size=2, tp_size=4)
+    else:
+        mm_b = MeshManager(dp_size=2, tp_size=2,
+                           devices=jax.devices()[:4])
+    plan_b = build_parallel_plan(model, mm_b)
+    tx = build_optimizer(name="adamw", lr=1e-2, weight_decay=0.01)
+    fns_b = build_train_step(model, tx, plan=plan_b)
+
+    params_b = ckpt.load_model(model, mdir, orbax_cfg,
+                               shardings=plan_b.param_sharding)
+    # optimizer: abstract tree with mesh-B shardings (what the recipe's
+    # load_checkpoint builds from its freshly-initialized opt_state)
+    init_b = fns_b.init_opt_state(params_b)
+    abs_b = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        init_b)
+    opt_b = ckpt.load_optimizer(odir, abs_b)
+
+    # restored state is placed on mesh B
+    some_leaf = jax.tree.leaves(params_b)[0]
+    assert some_leaf.sharding.mesh.devices.size == mm_b.world_size
+
+    b_b = jax.device_put(batch, fns_b.microbatch_sharding)
+    _, _, metrics_b = fns_b.train_step(params_b, opt_b, b_b)
+    loss_b = float(metrics_b["loss"])
+    assert loss_b == pytest.approx(ref_loss, abs=1e-5), (
+        f"resumed-on-{target} loss {loss_b} != uninterrupted {ref_loss}")
+
+
+def test_restored_params_bitwise_equal_across_meshes(tmp_path):
+    """The restored global arrays themselves (not just the loss) must be
+    identical regardless of the restore mesh."""
+    model = _model()
+    mm_a = MeshManager(dp_size=4, tp_size=2)
+    plan_a = build_parallel_plan(model, mm_a)
+    params = plan_a.shard_params(model.init(jax.random.key(1)))
+    path = str(tmp_path / "p")
+    ckpt.save_pytree(path, params)
+
+    mm_b = MeshManager(dp_size=1, tp_size=8)
+    plan_b = build_parallel_plan(model, mm_b)
+    abstract = _abstract_sharded(model.abstract_params(),
+                                 plan_b.param_sharding)
+    restored = ckpt.restore_pytree(path, abstract)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

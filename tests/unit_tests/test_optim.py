@@ -1,5 +1,6 @@
 import math
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -129,3 +130,40 @@ def test_param_group_lr_wd_multipliers():
     updates, _ = tx.update(grads, state, params)
     np.testing.assert_allclose(
         np.asarray(updates["embed"]["w"]), -0.1, rtol=1e-3)
+
+
+def test_no_weight_decay_leaves_excluded():
+    """e_score_correction_bias (DeepSeek routing bias — a frozen buffer in
+    HF) must receive NO decoupled weight decay: with zero gradient it would
+    otherwise silently decay toward 0 and shift expert selection."""
+    params = {
+        "gate": {"kernel": jnp.ones((4,)),
+                 "e_score_correction_bias": jnp.ones((4,))},
+    }
+    tx = build_optimizer(name="adamw", lr=1.0, weight_decay=0.1)
+    state = tx.init(params)
+    grads = jax.tree.map(jnp.zeros_like, params)
+    updates, _ = tx.update(grads, state, params)
+    # kernel: zero grad but wd still applies (-lr * wd * p)
+    np.testing.assert_allclose(
+        np.asarray(updates["gate"]["kernel"]), -0.1, rtol=1e-5)
+    # bias: fully untouched
+    np.testing.assert_allclose(
+        np.asarray(updates["gate"]["e_score_correction_bias"]), 0.0)
+
+
+def test_no_weight_decay_leaves_excluded_with_param_groups():
+    params = {
+        "gate": {"kernel": jnp.ones((4,)),
+                 "e_score_correction_bias": jnp.ones((4,))},
+    }
+    tx = build_optimizer(
+        name="adamw", lr=1.0, weight_decay=0.1,
+        param_groups=[{"params": ["gate*"], "wd_mult": 2.0}], params=params)
+    state = tx.init(params)
+    grads = jax.tree.map(jnp.zeros_like, params)
+    updates, _ = tx.update(grads, state, params)
+    np.testing.assert_allclose(
+        np.asarray(updates["gate"]["kernel"]), -0.2, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(updates["gate"]["e_score_correction_bias"]), 0.0)
