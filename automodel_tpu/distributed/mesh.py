@@ -3,7 +3,8 @@
 Where the reference builds a 4-D ``torch.distributed`` DeviceMesh and flattens
 submeshes (``nemo_automodel/components/distributed/fsdp2.py:117-221``), the TPU
 design is a single ``jax.sharding.Mesh`` with axes
-``('dp_replicate', 'dp_shard', 'cp', 'tp')``.  "Flattened" submeshes are not
+``('pp', 'dp_replicate', 'dp_shard', 'cp', 'tp')`` (``pp`` is the reserved
+size-1 pipeline seam — see the design note below).  "Flattened" submeshes are not
 separate objects in JAX — a PartitionSpec may name a *tuple* of axes, so the
 reference's ``dp``/``dp_shard_cp``/``dp_cp`` flattened views become the axis
 tuples returned by :data:`DP_AXES`, :data:`FSDP_AXES`, :data:`LOSS_AXES`.
@@ -22,11 +23,32 @@ import numpy as np
 from jax.sharding import Mesh
 
 # Canonical axis names, outermost (DCN) to innermost (ICI).
+#
+# ``pp`` is the RESERVED pipeline-parallel seam (size 1 today, absent in
+# both this framework and the reference — its README defers PP to a later
+# release).  The design when it lands, so 70B+ plans are not boxed out:
+#
+# * The layer stack is already a ``[L, ...]`` pytree scanned by one body —
+#   stage-splitting is a reshape to ``[pp, L/pp, ...]`` with the leading
+#   axis sharded over ``pp`` (each stage owns its layer slab; the existing
+#   ``scan_block`` machinery in ``models/llama.py`` shows the reshape).
+# * Schedule: ``shard_map`` over ``pp``; each stage scans its local
+#   ``L/pp`` layers and ``jax.lax.ppermute`` passes activations to the
+#   next stage.  Microbatching rides the existing grad-accumulation scan
+#   (``training/train_step.py``) — looping it over 2x pp microbatches
+#   yields the classic 1F1B-ish bubble fraction without new machinery.
+# * Placement: ``pp`` sits OUTERMOST (above dp_replicate) — stage
+#   boundaries are point-to-point transfers, the only traffic pattern that
+#   tolerates DCN latency; dense collectives stay on the inner ICI axes.
+# * Checkpoints are unaffected: Orbax stores global arrays, and the
+#   mesh-reshape restore tests prove resharding across layouts.
+AXIS_PP = "pp"
 AXIS_DP_REPLICATE = "dp_replicate"
 AXIS_DP_SHARD = "dp_shard"
 AXIS_CP = "cp"
 AXIS_TP = "tp"
-MESH_AXES: Tuple[str, ...] = (AXIS_DP_REPLICATE, AXIS_DP_SHARD, AXIS_CP, AXIS_TP)
+MESH_AXES: Tuple[str, ...] = (AXIS_PP, AXIS_DP_REPLICATE, AXIS_DP_SHARD,
+                              AXIS_CP, AXIS_TP)
 
 # Flattened views (reference fsdp2.py:181-221):
 #   dp          = dp_replicate x dp_shard      -> data/batch sharding
@@ -47,6 +69,7 @@ class MeshConfig:
     dp_replicate_size: int = 1
     tp_size: int = 1
     cp_size: int = 1
+    pp_size: int = 1          # reserved seam — only 1 is implemented
     sequence_parallel: bool = False
 
 
@@ -69,12 +92,18 @@ class MeshManager:
         dp_replicate_size: int = 1,
         tp_size: int = 1,
         cp_size: int = 1,
+        pp_size: int = 1,
         sequence_parallel: bool = False,
         expert_parallel: bool = False,
         devices: Optional[Sequence[jax.Device]] = None,
         allow_split_physical_axes: bool = True,
         **_unused,
     ):
+        if _none_to(pp_size, 1) != 1:
+            raise NotImplementedError(
+                "pipeline parallelism is a reserved seam (pp axis exists, "
+                "size 1 only) — see the design note at the top of this "
+                "module")
         self.sequence_parallel = bool(sequence_parallel)
         # MoE expert placement: experts sharded over the tp axis (EP) vs
         # TP inside each expert — see ``shardings.default_rules``.
@@ -121,7 +150,10 @@ class MeshManager:
             )
         except Exception:
             dev_array = np.asarray(devices).reshape(self.shape)
-        self.mesh = Mesh(dev_array, MESH_AXES)
+        # the reserved pp axis rides along at size 1 (outermost): specs
+        # that never name it see identical behavior
+        self.mesh_shape: Tuple[int, ...] = (1,) + self.shape
+        self.mesh = Mesh(dev_array.reshape(self.mesh_shape), MESH_AXES)
 
     # -- reference-parity size accessors ----------------------------------
     @property
@@ -162,7 +194,8 @@ class MeshManager:
         return self._ctx.__exit__(*exc)
 
     def __repr__(self) -> str:
-        return f"MeshManager(shape={dict(zip(MESH_AXES, self.shape))})"
+        return (f"MeshManager(shape="
+                f"{dict(zip(MESH_AXES, self.mesh_shape))})")
 
 
 def _none_to(v, default):
@@ -175,7 +208,8 @@ def build_mesh(cfg=None, **kwargs) -> MeshManager:
     """Convenience builder from a ConfigNode or kwargs."""
     if cfg is not None:
         fields = {k: cfg.get(k) for k in (
-            "dp_size", "dp_replicate_size", "tp_size", "cp_size", "sequence_parallel"
+            "dp_size", "dp_replicate_size", "tp_size", "cp_size", "pp_size",
+            "sequence_parallel"
         ) if k in cfg}
         fields.update(kwargs)
         kwargs = fields
