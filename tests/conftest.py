@@ -54,11 +54,15 @@ _SLOW_MODULES = {
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: heavy parity/e2e tests excluded from the core tier")
+    config.addinivalue_line(
+        "markers", "core: keep in the fast tier even inside a slow module "
+        "(one cheap end-to-end representative per major code path)")
 
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
-        if item.module.__name__.split(".")[-1] in _SLOW_MODULES:
+        if (item.module.__name__.split(".")[-1] in _SLOW_MODULES
+                and item.get_closest_marker("core") is None):
             item.add_marker(pytest.mark.slow)
 
 

@@ -57,6 +57,7 @@ def test_sigterm_preemption_checkpoints_and_exits(tmp_path):
     assert resumed.step_scheduler.step == recipe.step_scheduler.step
 
 
+@pytest.mark.core
 def test_recipe_trains_and_checkpoints(tmp_path):
     recipe = _make_recipe(tmp_path).setup()
     first = recipe._run_train_optim_step(next(iter(recipe.step_scheduler)))
@@ -74,6 +75,7 @@ def test_recipe_trains_and_checkpoints(tmp_path):
     assert os.path.exists(os.path.join(latest, "step_scheduler.pt"))
 
 
+@pytest.mark.core
 def test_recipe_resume_restores_state(tmp_path):
     r1 = _make_recipe(tmp_path, ["--step_scheduler.max_steps", "4"]).setup()
     r1.run_train_validation_loop()
@@ -92,6 +94,7 @@ def test_recipe_resume_restores_state(tmp_path):
     assert max(jax.tree.leaves(diffs)) == 0.0
 
 
+@pytest.mark.core
 def test_recipe_mixtral_moe(tmp_path):
     """MoE end-to-end through the finetune recipe on a dp4 x tp2 mesh with
     expert parallelism — the reference's 2-layer-Mixtral functional-CI role

@@ -9,6 +9,7 @@ import os
 
 import jax
 import numpy as np
+import pytest
 
 from automodel_tpu.config.arg_parser import parse_args_and_load_config
 
@@ -25,6 +26,7 @@ def _make_recipe(tmp_path, extra=()):
     return FinetuneRecipeForVLM(parse_args_and_load_config(argv))
 
 
+@pytest.mark.core
 def test_vlm_recipe_trains_and_checkpoints(tmp_path):
     recipe = _make_recipe(tmp_path).setup()
     first = recipe._run_train_optim_step(next(iter(recipe.step_scheduler)))
