@@ -36,8 +36,10 @@ leaf name so standard AdamW configs cannot silently decay it.
 Scope notes: rope is yarn (``ops/rotary.rope_parameters``) with the
 DeepSeek interleaved channel layout (``rope_interleave: true`` —
 de-interleaved before the standard half-split rotation, which preserves
-q.k inner products exactly); decode kv-cache and rank-r LoRA bypass are
-not wired for the MLA projections yet and fail loudly.
+q.k inner products exactly).  Decode uses a full expanded-kv cache
+(v padded to ``qk_head_dim``); the latent-kv cache — MLA's inference
+memory trick — is a known optimization, not wired.  Rank-r LoRA bypass
+is not wired for the MLA projections and fails loudly.
 """
 
 from __future__ import annotations
@@ -307,7 +309,7 @@ class DeepseekV3ForCausalLM(LlamaForCausalLM):
             if self.config.rope_interleave else x
 
     def _mla_attention(self, x, p, position_ids, segment_ids, attention_mask,
-                      inv_freq, rope_scale):
+                      inv_freq, rope_scale, kv_cache=None, cache_index=None):
         cfg = self.config
         B, S, H = x.shape
         Hq = cfg.num_attention_heads
@@ -345,11 +347,36 @@ class DeepseekV3ForCausalLM(LlamaForCausalLM):
         # the same); softmax(qk) @ padded-v leaves the pad zero — slice it.
         vh = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, dn + dr - dv))) \
             if dv != dn + dr else v
-        out = attention(qh, kh, vh, causal=True, segment_ids=segment_ids,
-                        attention_mask=attention_mask,
-                        scale=self._attn_scale)
+        new_cache = None
+        if kv_cache is not None:
+            # decode v1: cache the EXPANDED per-head k / padded v (the
+            # latent-cache decode — storing only [kv_lora + rope] per token
+            # — is the known MLA inference optimization, not wired yet).
+            from automodel_tpu.ops.attention import cached_attention
+
+            k_cache = lax.dynamic_update_slice(
+                kv_cache["k"], kh.astype(kv_cache["k"].dtype),
+                (0, cache_index, 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                kv_cache["v"], vh.astype(kv_cache["v"].dtype),
+                (0, cache_index, 0, 0))
+            new_cache = {"k": k_cache, "v": v_cache}
+            if S > 1:       # prefill attends only its own keys
+                out = attention(qh, kh, vh, causal=True,
+                                attention_mask=(
+                                    None if attention_mask is None
+                                    else attention_mask[:, :S]),
+                                scale=self._attn_scale)
+            else:
+                out = cached_attention(
+                    qh, k_cache, v_cache, cache_index=cache_index, q_len=S,
+                    attention_mask=attention_mask, scale=self._attn_scale)
+        else:
+            out = attention(qh, kh, vh, causal=True, segment_ids=segment_ids,
+                            attention_mask=attention_mask,
+                            scale=self._attn_scale)
         out = out[..., :dv]
-        return proj(out.reshape(B, S, Hq * dv), p["o_proj"])
+        return proj(out.reshape(B, S, Hq * dv), p["o_proj"]), new_cache
 
     def _dense_mlp(self, x, p):
         cd = self.compute_dtype
@@ -403,42 +430,49 @@ class DeepseekV3ForCausalLM(LlamaForCausalLM):
             raise NotImplementedError(
                 "rank-r LoRA bypass is not wired for the MLA projections; "
                 "use peft merge mode")
-        if kv_cache is not None:
-            raise NotImplementedError(
-                "deepseek_v3 decode cache (latent kv) is not implemented")
         B, S = hidden.shape[:2]
+        decoding = kv_cache is not None
         if position_ids is None:
-            position_ids = jnp.broadcast_to(
+            start = 0 if cache_index is None else cache_index
+            position_ids = start + jnp.broadcast_to(
                 jnp.arange(S, dtype=jnp.int32), (B, S))
         hidden = constrain(hidden.astype(self.compute_dtype),
                            ("act_batch", "act_seq", "act_embed"))
         inv_freq, rope_scale = self._rope_tables(position_ids)
 
-        def layer(h, p, moe: bool):
+        def layer(h, p, moe: bool, cache):
             resid = h
             x = rms_norm(h, p["input_layernorm"]["weight"], cfg.rms_norm_eps)
-            h = resid + self._mla_attention(
+            attn, new_cache = self._mla_attention(
                 x, p["self_attn"], position_ids, segment_ids, attention_mask,
-                inv_freq, rope_scale)
+                inv_freq, rope_scale, kv_cache=cache, cache_index=cache_index)
+            h = resid + attn
             resid = h
             x = rms_norm(h, p["post_attention_layernorm"]["weight"],
                          cfg.rms_norm_eps)
             out = self._moe_mlp(x, p["mlp"]) if moe \
                 else self._dense_mlp(x, p["mlp"])
             return constrain(resid + out, ("act_batch", "act_seq",
-                                           "act_embed"))
+                                           "act_embed")), new_cache
 
         policy = resolve_remat_policy(self.remat_policy)
+        new_kv = {} if decoding else None
         for name, moe in (("dense_layers", False), ("layers", True)):
             if name not in params:
                 continue
 
-            def body(h, p, moe=moe):
-                return layer(h, p, moe), None
+            def body(h, xs, moe=moe):
+                p, cache = xs
+                h, new_cache = layer(h, p, moe, cache)
+                return h, new_cache
 
-            if self.remat:
+            if self.remat and not decoding:
                 body = jax.checkpoint(body, policy=policy, prevent_cse=False)
-            hidden, _ = lax.scan(body, hidden, params[name])
+            stack_cache = kv_cache.get(name) if decoding else None
+            hidden, stack_new = lax.scan(body, hidden,
+                                         (params[name], stack_cache))
+            if decoding:
+                new_kv[name] = stack_new
 
         hidden = rms_norm(hidden, params["norm"]["weight"], cfg.rms_norm_eps)
         lm_kernel = (params["embed_tokens"]["embedding"].T
@@ -452,6 +486,26 @@ class DeepseekV3ForCausalLM(LlamaForCausalLM):
             logits = hidden @ lm_kernel.astype(self.compute_dtype)
             out = {"logits": constrain(
                 logits, ("act_batch", "act_seq_nosp", "act_vocab"))}
+        if decoding:
+            out["kv_cache"] = new_kv
+        return out
+
+    def init_kv_cache(self, batch: int, max_len: int,
+                      dtype: Optional[Any] = None) -> Dict[str, Any]:
+        """Static decode cache per layer sub-stack: expanded per-head keys
+        ``[n, B, max_len, Hq, qk_head_dim]`` and v PADDED to the same head
+        dim (see ``_mla_attention``)."""
+        cfg = self.config
+        dtype = dtype or self.compute_dtype
+        kd = cfg.first_k_dense_replace
+        out: Dict[str, Any] = {}
+        for name, n in (("dense_layers", kd),
+                        ("layers", cfg.num_hidden_layers - kd)):
+            if n:
+                shape = (n, batch, max_len, cfg.num_attention_heads,
+                         cfg.qk_head_dim)
+                out[name] = {"k": jnp.zeros(shape, dtype),
+                             "v": jnp.zeros(shape, dtype)}
         return out
 
     def flops_per_token(self) -> float:

@@ -546,7 +546,14 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         return self.last_metrics
 
     def _finalize_metrics(self, pending) -> Dict[str, Any]:
-        dm = jax.device_get(pending["device_metrics"])  # one transfer
+        dmv = pending["device_metrics"]
+        if "_packed" in dmv:
+            # single d2h transfer for all scalars (see train_step.py)
+            vals = jax.device_get(dmv["_packed"])
+            dm = {"loss": float(vals[0]), "grad_norm": float(vals[1]),
+                  "num_label_tokens": float(vals[2])}
+        else:
+            dm = jax.device_get(dmv)
         dt = time.perf_counter() - pending["t_dispatch"]
         # NaN/inf guard (the reference's check_for_nan_in_grad role,
         # ``distributed/parallelizer.py:478``): fail fast instead of

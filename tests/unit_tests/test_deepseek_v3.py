@@ -166,3 +166,23 @@ def test_hf_roundtrip_bitwise(tmp_path):
     assert len(flat1) == len(flat2)
     for a, b in zip(flat1, flat2):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_greedy_generate_matches_transformers(tmp_path):
+    """KV-cache decode parity (expanded-kv cache, v padded to qk_head_dim)."""
+    from automodel_tpu.generation import GenerationConfig, generate
+
+    cfg = _base_cfg(q_lora_rank=24)
+    model = DeepseekV3ForCausalLM(cfg, param_dtype=jnp.float32,
+                                  compute_dtype=jnp.float32, remat=False)
+    params = _randomized(model, jax.random.key(5))
+    hf = _export(model, params, tmp_path)
+
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(3, cfg.vocab_size - 1, (1, 9)).astype(np.int64)
+    ours = generate(model, params, prompt,
+                    config=GenerationConfig(max_new_tokens=6))
+    with torch.no_grad():
+        hf_out = hf.generate(torch.from_numpy(prompt), max_new_tokens=6,
+                             do_sample=False, pad_token_id=0)
+    np.testing.assert_array_equal(ours[0], hf_out[0, 9:].numpy())
