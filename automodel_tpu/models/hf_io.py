@@ -142,6 +142,43 @@ def qwen3_moe_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
     return m
 
 
+def olmo2_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    """OLMo-2 (HF ``Olmo2ForCausalLM``): llama projections, post-norm
+    layout (post_attention + post_feedforward norms), full-width q/k
+    norms."""
+    m = llama_key_map(config)
+    del m[("layers", "input_layernorm", "weight")]
+    m[("layers", "post_feedforward_layernorm", "weight")] = HfSpec(
+        "model.layers.{i}.post_feedforward_layernorm.weight", stacked=True)
+    for norm in ("q_norm", "k_norm"):
+        m[("layers", "self_attn", norm, "weight")] = HfSpec(
+            f"model.layers.{{i}}.self_attn.{norm}.weight", stacked=True)
+    return m
+
+
+def starcoder2_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    """StarCoder-2 (HF ``Starcoder2ForCausalLM``): llama attention with
+    biases everywhere, LayerNorm (+bias) blocks, c_fc/c_proj GELU MLP."""
+    m = llama_key_map(config)
+    for proj in ("gate_proj", "up_proj", "down_proj"):
+        del m[("layers", "mlp", proj, "kernel")]
+    for proj in ("c_fc", "c_proj"):
+        m[("layers", "mlp", proj, "kernel")] = HfSpec(
+            f"model.layers.{{i}}.mlp.{proj}.weight", stacked=True,
+            transpose=True)
+        if config.use_bias:
+            m[("layers", "mlp", proj, "bias")] = HfSpec(
+                f"model.layers.{{i}}.mlp.{proj}.bias", stacked=True)
+    if config.use_bias:
+        m[("layers", "self_attn", "o_proj", "bias")] = HfSpec(
+            "model.layers.{i}.self_attn.o_proj.bias", stacked=True)
+    for norm in ("input_layernorm", "post_attention_layernorm"):
+        m[("layers", norm, "bias")] = HfSpec(
+            f"model.layers.{{i}}.{norm}.bias", stacked=True)
+    m[("norm", "bias")] = HfSpec("model.norm.bias")
+    return m
+
+
 def deepseek_v3_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
     """DeepSeek-V2/V3 (HF ``DeepseekV3ForCausalLM`` naming): MLA attention
     projections plus the split dense/MoE layer stacks.  HF layer ``i`` maps

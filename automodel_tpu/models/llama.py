@@ -317,15 +317,16 @@ class LlamaForCausalLM:
         return apply_rope(q, k, position_ids, inv_freq,
                           attention_scaling=rope_scale)
 
-    def _decoder_layer(self, hidden, layer_params, position_ids, segment_ids,
-                       attention_mask, inv_freq, adapters=None,
-                       adapter_scale=1.0, adapter_dropout=0.0,
-                       dropout_position="post", dropout_rng=None,
-                       kv_cache=None, cache_index=None, rope_scale=1.0):
-        cfg = self.config
-        B, S, H = hidden.shape
-        D, Hq, Hk = cfg.head_dim, cfg.num_attention_heads, cfg.num_key_value_heads
-        p = layer_params
+    def _norm(self, x, p, eps):
+        """Block-norm hook: RMSNorm here; LayerNorm families (StarCoder-2)
+        override."""
+        return rms_norm(x, p["weight"], eps)
+
+    def _make_proj(self, adapters, adapter_scale, adapter_dropout,
+                   dropout_position, dropout_rng):
+        """Projection closure shared by every decoder-layer variant:
+        int8 weight-only dequant, quantized-compute routing, rank-r LoRA
+        bypass, optional bias."""
         cd = self.compute_dtype
 
         def proj(x, w, name):
@@ -363,17 +364,12 @@ class LlamaForCausalLM:
                 y = y + w["bias"].astype(cd)
             return y
 
-        # Attention block
-        resid = hidden
-        x = rms_norm(hidden, p["input_layernorm"]["weight"], cfg.rms_norm_eps)
-        q = proj(x, p["self_attn"]["q_proj"], "self_attn.q_proj").reshape(B, S, Hq, D)
-        k = proj(x, p["self_attn"]["k_proj"], "self_attn.k_proj").reshape(B, S, Hk, D)
-        v = proj(x, p["self_attn"]["v_proj"], "self_attn.v_proj").reshape(B, S, Hk, D)
-        if cfg.qk_norm:
-            q = rms_norm(q, p["self_attn"]["q_norm"]["weight"], cfg.rms_norm_eps)
-            k = rms_norm(k, p["self_attn"]["k_norm"]["weight"], cfg.rms_norm_eps)
-        q, k = self._apply_rope(q, k, position_ids, inv_freq, rope_scale)
-        new_cache = None
+        return proj
+
+    def _attention_core(self, q, k, v, segment_ids, attention_mask,
+                        kv_cache, cache_index, local_window_size=None):
+        """Train/prefill/decode attention + cache update on rotated q/k."""
+        S = q.shape[1]
         if kv_cache is not None:
             # Autoregressive decode: write this step's k/v into the static
             # [B, S_max, Hk, D] cache.  Prefill (S > 1) attends only over
@@ -383,27 +379,58 @@ class LlamaForCausalLM:
             from automodel_tpu.ops.attention import cached_attention
 
             k_cache = lax.dynamic_update_slice(
-                kv_cache["k"], k.astype(kv_cache["k"].dtype), (0, cache_index, 0, 0))
+                kv_cache["k"], k.astype(kv_cache["k"].dtype),
+                (0, cache_index, 0, 0))
             v_cache = lax.dynamic_update_slice(
-                kv_cache["v"], v.astype(kv_cache["v"].dtype), (0, cache_index, 0, 0))
+                kv_cache["v"], v.astype(kv_cache["v"].dtype),
+                (0, cache_index, 0, 0))
             new_cache = {"k": k_cache, "v": v_cache}
             if S > 1:
                 attn = attention(
                     q, k, v, causal=True,
                     attention_mask=(None if attention_mask is None
-                                    else attention_mask[:, :S]))
+                                    else attention_mask[:, :S]),
+                    local_window_size=local_window_size)
             else:
                 attn = cached_attention(
                     q, k_cache, v_cache,
                     cache_index=cache_index, q_len=S,
-                    attention_mask=attention_mask)
-        else:
-            attn = attention(
-                q, k, v,
-                causal=True,
-                segment_ids=segment_ids,
-                attention_mask=attention_mask,
-            )
+                    attention_mask=attention_mask,
+                    local_window_size=local_window_size)
+            return attn, new_cache
+        attn = attention(
+            q, k, v,
+            causal=True,
+            segment_ids=segment_ids,
+            attention_mask=attention_mask,
+            local_window_size=local_window_size,
+        )
+        return attn, None
+
+    def _decoder_layer(self, hidden, layer_params, position_ids, segment_ids,
+                       attention_mask, inv_freq, adapters=None,
+                       adapter_scale=1.0, adapter_dropout=0.0,
+                       dropout_position="post", dropout_rng=None,
+                       kv_cache=None, cache_index=None, rope_scale=1.0):
+        cfg = self.config
+        B, S, H = hidden.shape
+        D, Hq, Hk = cfg.head_dim, cfg.num_attention_heads, cfg.num_key_value_heads
+        p = layer_params
+        proj = self._make_proj(adapters, adapter_scale, adapter_dropout,
+                               dropout_position, dropout_rng)
+
+        # Attention block
+        resid = hidden
+        x = self._norm(hidden, p["input_layernorm"], cfg.rms_norm_eps)
+        q = proj(x, p["self_attn"]["q_proj"], "self_attn.q_proj").reshape(B, S, Hq, D)
+        k = proj(x, p["self_attn"]["k_proj"], "self_attn.k_proj").reshape(B, S, Hk, D)
+        v = proj(x, p["self_attn"]["v_proj"], "self_attn.v_proj").reshape(B, S, Hk, D)
+        if cfg.qk_norm:
+            q = rms_norm(q, p["self_attn"]["q_norm"]["weight"], cfg.rms_norm_eps)
+            k = rms_norm(k, p["self_attn"]["k_norm"]["weight"], cfg.rms_norm_eps)
+        q, k = self._apply_rope(q, k, position_ids, inv_freq, rope_scale)
+        attn, new_cache = self._attention_core(
+            q, k, v, segment_ids, attention_mask, kv_cache, cache_index)
         attn = checkpoint_name(attn, "attn_core")
         attn = proj(attn.reshape(B, S, Hq * D), p["self_attn"]["o_proj"],
                     "self_attn.o_proj")
@@ -411,7 +438,7 @@ class LlamaForCausalLM:
 
         # MLP block (dense SwiGLU here; MoE families override ``_mlp_block``)
         resid = hidden
-        x = rms_norm(hidden, p["post_attention_layernorm"]["weight"], cfg.rms_norm_eps)
+        x = self._norm(hidden, p["post_attention_layernorm"], cfg.rms_norm_eps)
         down, moe_aux = self._mlp_block(x, p, proj)
         # SP/CP activation layout between blocks (no-op without a sharding ctx)
         out = constrain(resid + down, ("act_batch", "act_seq", "act_embed"))
@@ -570,7 +597,7 @@ class LlamaForCausalLM:
             new_cache, aux_losses = jax.tree.map(
                 lambda a: a.reshape(L, *a.shape[2:]), (new_cache, aux_losses))
 
-        hidden = rms_norm(hidden, params["norm"]["weight"], cfg.rms_norm_eps)
+        hidden = self._norm(hidden, params["norm"], cfg.rms_norm_eps)
         lm_kernel = (
             params["embed_tokens"]["embedding"].T
             if cfg.tie_word_embeddings

@@ -154,3 +154,16 @@ def _ensure_builtin() -> None:
                                DeepseekV3ForCausalLM,
                                hf_io.deepseek_v3_key_map,
                                ["DeepseekV3ForCausalLM"]))
+    from automodel_tpu.models.olmo2 import Olmo2Config, Olmo2ForCausalLM
+
+    register_model(ModelFamily("olmo2", Olmo2Config, Olmo2ForCausalLM,
+                               hf_io.olmo2_key_map, ["Olmo2ForCausalLM"]))
+    from automodel_tpu.models.starcoder2 import (
+        Starcoder2Config,
+        Starcoder2ForCausalLM,
+    )
+
+    register_model(ModelFamily("starcoder2", Starcoder2Config,
+                               Starcoder2ForCausalLM,
+                               hf_io.starcoder2_key_map,
+                               ["Starcoder2ForCausalLM"]))

@@ -1,0 +1,106 @@
+"""StarCoder-2 family (HF ``model_type: starcoder2``).
+
+The reference trains these through HF transformers
+(``nemo_automodel/components/_transformers/auto_model.py:384``); parity
+target is ``transformers/models/starcoder2/modeling_starcoder2.py``.
+A pre-norm Llama-shaped decoder with GPT-2 genes:
+
+* **LayerNorm** (weight + bias) everywhere instead of RMSNorm
+  (``config.norm_epsilon``);
+* **biased projections** — q/k/v/o and the MLP all carry biases
+  (``use_bias``);
+* **plain GELU MLP** — ``c_fc -> gelu(tanh) -> c_proj``, no gating.
+
+Attention/rope/cache/LoRA machinery is inherited from
+``LlamaForCausalLM`` through the ``_make_proj`` / ``_attention_core`` /
+``_norm`` hooks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from automodel_tpu.ops.norms import layer_norm
+from automodel_tpu.ops.remat import checkpoint_name
+
+
+@dataclasses.dataclass
+class Starcoder2Config(LlamaConfig):
+    use_bias: bool = True
+    norm_epsilon: float = 1e-5
+    sliding_window: int = None   # released checkpoints: 4096
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.model_type = "starcoder2"
+        self.rms_norm_eps = self.norm_epsilon   # reuse the base plumb
+        self.attention_bias = bool(self.use_bias)
+
+
+class Starcoder2ForCausalLM(LlamaForCausalLM):
+    """``model_type: starcoder2`` — LayerNorm + biased GELU-MLP Llama."""
+
+    def _norm(self, x, p, eps):
+        return layer_norm(x, p["weight"], p["bias"], eps)
+
+    def _attention_core(self, q, k, v, segment_ids, attention_mask,
+                        kv_cache, cache_index):
+        return super()._attention_core(
+            q, k, v, segment_ids, attention_mask, kv_cache, cache_index,
+            local_window_size=self.config.sliding_window)
+
+    def _init_ffn(self, keys, dense) -> Dict[str, Any]:
+        cfg = self.config
+        H, I = cfg.hidden_size, cfg.intermediate_size
+        L = cfg.num_hidden_layers
+        mlp = {
+            "c_fc": {"kernel": dense(next(keys), (H, I))},
+            "c_proj": {"kernel": dense(next(keys), (I, H))},
+        }
+        if cfg.use_bias:
+            mlp["c_fc"]["bias"] = jnp.zeros((L, I), self.param_dtype)
+            mlp["c_proj"]["bias"] = jnp.zeros((L, H), self.param_dtype)
+        return {"mlp": mlp}
+
+    def _ffn_axes(self) -> Dict[str, Any]:
+        mlp = {
+            "c_fc": {"kernel": ("layers", "embed", "mlp")},
+            "c_proj": {"kernel": ("layers", "mlp", "embed")},
+        }
+        if self.config.use_bias:
+            mlp["c_fc"]["bias"] = ("layers", "mlp")
+            mlp["c_proj"]["bias"] = ("layers", "norm")
+        return {"mlp": mlp}
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        params = super().init(key)
+        cfg = self.config
+        L, H = cfg.num_hidden_layers, cfg.hidden_size
+        zeros = lambda shape: jnp.zeros(shape, self.param_dtype)
+        # LayerNorm biases
+        for norm in ("input_layernorm", "post_attention_layernorm"):
+            params["layers"][norm]["bias"] = zeros((L, H))
+        params["norm"]["bias"] = zeros((H,))
+        if cfg.use_bias:
+            params["layers"]["self_attn"]["o_proj"]["bias"] = zeros((L, H))
+        return params
+
+    def param_axes(self) -> Dict[str, Any]:
+        axes = super().param_axes()
+        cfg = self.config
+        for norm in ("input_layernorm", "post_attention_layernorm"):
+            axes["layers"][norm]["bias"] = ("layers", "norm")
+        axes["norm"]["bias"] = ("norm",)
+        if cfg.use_bias:
+            axes["layers"]["self_attn"]["o_proj"]["bias"] = ("layers", "norm")
+        return axes
+
+    def _mlp_block(self, x, p, proj):
+        h = proj(x, p["mlp"]["c_fc"], "mlp.c_fc")
+        h = checkpoint_name(jax.nn.gelu(h, approximate=True), "mlp_silu")
+        return proj(h, p["mlp"]["c_proj"], "mlp.c_proj"), None

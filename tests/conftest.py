@@ -37,7 +37,7 @@ _SLOW_MODULES = {
     "test_new_text_families", "test_qwen25_vl", "test_phi4_mm",
     "test_mixtral", "test_hf_io", "test_sequence_classification",
     "test_generation", "test_models", "test_deepseek_v3",
-    "test_rope_scaling",
+    "test_rope_scaling", "test_olmo2_starcoder2",
     # end-to-end recipe / multi-process tiers
     "test_train_ft_recipe", "test_vlm_finetune", "test_cli",
     "test_multiprocess_cpu", "test_checkpoint_resume", "test_pretrain",

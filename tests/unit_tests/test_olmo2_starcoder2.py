@@ -1,0 +1,138 @@
+"""HF parity for the round-5 day-0 breadth families: OLMo-2 (post-norm +
+full-width q/k norms) and StarCoder-2 (LayerNorm + biased GELU MLP) —
+the two non-DeepSeek architectures VERDICT r4 named as registry gaps.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from automodel_tpu.loss.masked_ce import cross_entropy_sum
+from automodel_tpu.models.olmo2 import Olmo2Config, Olmo2ForCausalLM
+from automodel_tpu.models.starcoder2 import (
+    Starcoder2Config,
+    Starcoder2ForCausalLM,
+)
+
+
+def _olmo2_case():
+    cfg = Olmo2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        max_position_embeddings=64)
+    return cfg, Olmo2ForCausalLM
+
+
+def _starcoder2_case():
+    cfg = Starcoder2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, tie_word_embeddings=True,
+        max_position_embeddings=64, use_bias=True)
+    return cfg, Starcoder2ForCausalLM
+
+
+def _starcoder2_sliding_case():
+    cfg = Starcoder2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, tie_word_embeddings=True,
+        max_position_embeddings=64, use_bias=True, sliding_window=8)
+    return cfg, Starcoder2ForCausalLM
+
+
+CASES = {"olmo2": _olmo2_case, "starcoder2": _starcoder2_case,
+         "starcoder2_sliding": _starcoder2_sliding_case}
+
+
+def _randomized(model, key):
+    params = model.init(key)
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(jax.random.fold_in(key, 7), len(leaves))
+    leaves = [
+        (l + 0.02 * jax.random.normal(k, l.shape, jnp.float32)).astype(l.dtype)
+        for l, k in zip(leaves, keys)
+    ]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def _export(model, params, path):
+    from automodel_tpu.models.hf_io import save_hf_weights
+
+    save_hf_weights(model, params, str(path))
+    cfg_path = os.path.join(str(path), "config.json")
+    with open(cfg_path) as f:
+        d = json.load(f)
+    d.update(pad_token_id=0, bos_token_id=1, eos_token_id=2)
+    with open(cfg_path, "w") as f:
+        json.dump(d, f, indent=2, default=str)
+    hf = transformers.AutoModelForCausalLM.from_pretrained(
+        str(path), torch_dtype=torch.float32, attn_implementation="eager")
+    hf.eval()
+    return hf
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_logits_and_loss_match_transformers(name, tmp_path):
+    cfg, cls = CASES[name]()
+    model = cls(cfg, param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                remat=False)
+    params = _randomized(model, jax.random.key(0))
+    hf = _export(model, params, tmp_path)
+
+    rng = np.random.default_rng(0)
+    B, S = 2, 24
+    input_ids = rng.integers(3, cfg.vocab_size, (B, S), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(input_ids)).logits.numpy()
+    out = model(params, jnp.asarray(input_ids.astype(np.int32)))
+    logits = np.asarray(out["logits"], dtype=np.float32)
+    np.testing.assert_allclose(logits, hf_logits, atol=2e-4, rtol=2e-3)
+
+    labels = jnp.asarray(input_ids.astype(np.int32))
+    loss = cross_entropy_sum(jnp.asarray(logits), labels) / labels.size
+    hf_loss = torch.nn.functional.cross_entropy(
+        torch.from_numpy(hf_logits).reshape(-1, cfg.vocab_size),
+        torch.from_numpy(input_ids).reshape(-1))
+    assert float(loss) == pytest.approx(float(hf_loss), rel=1e-4)
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_greedy_generate_matches_transformers(name, tmp_path):
+    from automodel_tpu.generation import GenerationConfig, generate
+
+    cfg, cls = CASES[name]()
+    model = cls(cfg, param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                remat=False)
+    params = _randomized(model, jax.random.key(3))
+    hf = _export(model, params, tmp_path)
+
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(3, cfg.vocab_size - 1, (1, 9)).astype(np.int64)
+    ours = generate(model, params, prompt,
+                    config=GenerationConfig(max_new_tokens=6))
+    with torch.no_grad():
+        hf_out = hf.generate(torch.from_numpy(prompt), max_new_tokens=6,
+                             do_sample=False, pad_token_id=0)
+    np.testing.assert_array_equal(ours[0], hf_out[0, 9:].numpy())
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_hf_roundtrip_bitwise(name, tmp_path):
+    from automodel_tpu.models.hf_io import load_hf_weights, save_hf_weights
+
+    cfg, cls = CASES[name]()
+    model = cls(cfg, param_dtype=jnp.float32, compute_dtype=jnp.float32)
+    params = _randomized(model, jax.random.key(2))
+    save_hf_weights(model, params, str(tmp_path))
+    restored = load_hf_weights(model, str(tmp_path))
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
