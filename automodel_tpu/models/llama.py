@@ -61,6 +61,12 @@ class LlamaConfig:
     tie_word_embeddings: bool = True
     attention_bias: bool = False       # Qwen2: True
     qk_norm: bool = False              # Qwen3: True (per-head RMSNorm on q/k)
+    # Sliding-window attention: Mistral v0.1 applies it globally whenever
+    # sliding_window is set; Qwen2 gates it behind use_sliding_window
+    # (HF default False) + max_window_layers.
+    sliding_window: Optional[int] = None
+    use_sliding_window: bool = True
+    max_window_layers: Optional[int] = None
     attention_dropout: float = 0.0     # accepted, unused (SFT default 0)
     model_type: str = "llama"
     torch_dtype: str = "bfloat16"
@@ -75,6 +81,10 @@ class LlamaConfig:
         kwargs = {k: v for k, v in hf.items() if k in known}
         if hf.get("model_type") == "qwen2":
             kwargs.setdefault("attention_bias", True)
+        if str(hf.get("model_type", "")).startswith(("qwen2", "qwen3")):
+            # HF Qwen*Config defaults use_sliding_window to False (the
+            # serialized config may omit it)
+            kwargs.setdefault("use_sliding_window", False)
         if hf.get("model_type") == "qwen3":
             kwargs["qk_norm"] = True
         return cls(**kwargs)
@@ -130,6 +140,27 @@ class LlamaForCausalLM:
         # (reference ``_peft/lora.py:32,308-314``), TPU-shaped: frozen base
         # weights cost 1 byte/param in HBM, adapters stay bf16/fp32.
         self.weight_only_quant = weight_only_quant
+        # Resolved sliding window for the shared attention core (uniform
+        # across layers; per-layer window/full mixes are the Gemma families'
+        # own forward).
+        sw = getattr(config, "sliding_window", None)
+        self._sliding_window = None
+        if sw and getattr(config, "use_sliding_window", True):
+            # HF semantics: layer i slides only when i >= max_window_layers
+            # — so mwl >= L means NO layer slides (the published Qwen2
+            # field combo), mwl in (0, L) is a mixed stack this shared
+            # decoder cannot express, and mwl None/0 slides everywhere
+            # (Mistral v0.1, StarCoder-2).
+            mwl = getattr(config, "max_window_layers", None)
+            if mwl is None or mwl == 0:
+                self._sliding_window = int(sw)
+            elif mwl >= config.num_hidden_layers:
+                self._sliding_window = None
+            else:
+                raise NotImplementedError(
+                    f"max_window_layers={mwl} inside (0, num_hidden_layers="
+                    f"{config.num_hidden_layers}): mixed sliding/full layer "
+                    "stacks are not wired for this family")
         self._init_rope(config.head_dim)
 
     def _init_rope(self, rotary_dim: int) -> None:
@@ -430,7 +461,8 @@ class LlamaForCausalLM:
             k = rms_norm(k, p["self_attn"]["k_norm"]["weight"], cfg.rms_norm_eps)
         q, k = self._apply_rope(q, k, position_ids, inv_freq, rope_scale)
         attn, new_cache = self._attention_core(
-            q, k, v, segment_ids, attention_mask, kv_cache, cache_index)
+            q, k, v, segment_ids, attention_mask, kv_cache, cache_index,
+            local_window_size=self._sliding_window)
         attn = checkpoint_name(attn, "attn_core")
         attn = proj(attn.reshape(B, S, Hq * D), p["self_attn"]["o_proj"],
                     "self_attn.o_proj")

@@ -473,29 +473,9 @@ class Phi4MMTextModel(LlamaForCausalLM):
         k = qkv[..., Hq * D:(Hq + Hk) * D].reshape(B, S, Hk, D)
         v = qkv[..., (Hq + Hk) * D:].reshape(B, S, Hk, D)
         q, k = self._apply_rope(q, k, position_ids, inv_freq, rope_scale)
-        new_cache = None
-        if kv_cache is not None:
-            from automodel_tpu.ops.attention import cached_attention
-
-            k_cache = lax.dynamic_update_slice(
-                kv_cache["k"], k.astype(kv_cache["k"].dtype),
-                (0, cache_index, 0, 0))
-            v_cache = lax.dynamic_update_slice(
-                kv_cache["v"], v.astype(kv_cache["v"].dtype),
-                (0, cache_index, 0, 0))
-            new_cache = {"k": k_cache, "v": v_cache}
-            if S > 1:
-                attn = attention(
-                    q, k, v, causal=True,
-                    attention_mask=(None if attention_mask is None
-                                    else attention_mask[:, :S]))
-            else:
-                attn = cached_attention(
-                    q, k_cache, v_cache, cache_index=cache_index, q_len=S,
-                    attention_mask=attention_mask)
-        else:
-            attn = attention(q, k, v, causal=True, segment_ids=segment_ids,
-                             attention_mask=attention_mask)
+        attn, new_cache = self._attention_core(
+            q, k, v, segment_ids, attention_mask, kv_cache, cache_index,
+            local_window_size=self._sliding_window)
         attn = attn.reshape(B, S, Hq * D) @ (
             p["self_attn"]["o_proj"]["kernel"].astype(cd))
         hidden = resid + attn

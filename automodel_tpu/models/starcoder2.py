@@ -33,7 +33,7 @@ from automodel_tpu.ops.remat import checkpoint_name
 class Starcoder2Config(LlamaConfig):
     use_bias: bool = True
     norm_epsilon: float = 1e-5
-    sliding_window: int = None   # released checkpoints: 4096
+    # sliding_window inherited from LlamaConfig (released checkpoints: 4096)
 
     def __post_init__(self):
         super().__post_init__()
@@ -47,12 +47,6 @@ class Starcoder2ForCausalLM(LlamaForCausalLM):
 
     def _norm(self, x, p, eps):
         return layer_norm(x, p["weight"], p["bias"], eps)
-
-    def _attention_core(self, q, k, v, segment_ids, attention_mask,
-                        kv_cache, cache_index):
-        return super()._attention_core(
-            q, k, v, segment_ids, attention_mask, kv_cache, cache_index,
-            local_window_size=self.config.sliding_window)
 
     def _init_ffn(self, keys, dense) -> Dict[str, Any]:
         cfg = self.config

@@ -38,6 +38,12 @@ CASES = {
         rope_theta=10000.0, tie_word_embeddings=False,
         max_position_embeddings=64, attention_bias=True,
         model_type="qwen2"),
+    "mistral_sliding_window": LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        max_position_embeddings=64, sliding_window=8,
+        model_type="mistral"),
     "qwen3_qk_norm": LlamaConfig(
         vocab_size=256, hidden_size=64, intermediate_size=128,
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
