@@ -113,6 +113,24 @@ def test_recipe_mixtral_moe(tmp_path):
     assert recipe.last_metrics["loss"] < first["loss"]
 
 
+def test_recipe_deepseek_mla_moe(tmp_path):
+    """DeepSeek MLA + no-aux MoE end-to-end through the finetune recipe on
+    a dp4 x tp2 mesh with expert parallelism (split dense/MoE stacks,
+    low-rank queries, shared experts)."""
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
+    )
+
+    yaml = os.path.join(os.path.dirname(YAML), "tiny_deepseek_mock.yaml")
+    cfg = parse_args_and_load_config(["--config", yaml])
+    recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+    first = recipe._run_train_optim_step(next(iter(recipe.step_scheduler)))
+    recipe.run_train_validation_loop()
+    assert recipe.step_scheduler.step == 6
+    assert np.isfinite(recipe.last_metrics["loss"])
+    assert recipe.last_metrics["loss"] < first["loss"]
+
+
 def test_epochs_only_lr_horizon_and_unpacked_pad(tmp_path):
     """Without max_steps the LR decay horizon must derive from epochs x
     steps-per-epoch (VERDICT r2 weak #3), and unpacked training batches must
