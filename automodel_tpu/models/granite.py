@@ -1,0 +1,43 @@
+"""IBM Granite family (HF ``model_type: granite``, e.g. granite-3.x-8b).
+
+The reference trains these through HF transformers
+(``nemo_automodel/components/_transformers/auto_model.py:384``); parity
+target is ``transformers/models/granite/modeling_granite.py``.  Granite is
+the Llama decoder plus four muP-style scalar multipliers, expressed
+entirely through the shared decoder's scalar hooks:
+
+* ``embedding_multiplier`` on the token embeddings,
+* ``attention_multiplier`` REPLACING the ``head_dim**-0.5`` softmax scale,
+* ``residual_multiplier`` on both block outputs before the residual add,
+* ``logits_scaling`` dividing the lm_head output (folded into the head
+  kernel on the fused-CE path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+@dataclasses.dataclass
+class GraniteConfig(LlamaConfig):
+    embedding_multiplier: float = 1.0
+    attention_multiplier: float = 1.0
+    residual_multiplier: float = 1.0
+    logits_scaling: float = 1.0
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.model_type = "granite"
+
+
+class GraniteForCausalLM(LlamaForCausalLM):
+    """``model_type: granite`` — Llama with muP-style scalar multipliers."""
+
+    def __init__(self, config: GraniteConfig, **kwargs):
+        super().__init__(config, **kwargs)
+        self._embedding_scale = float(config.embedding_multiplier)
+        self._residual_scale = float(config.residual_multiplier)
+        self._attn_softmax_scale = float(config.attention_multiplier)
+        self._logits_divisor = float(config.logits_scaling)

@@ -140,6 +140,12 @@ class LlamaForCausalLM:
         # (reference ``_peft/lora.py:32,308-314``), TPU-shaped: frozen base
         # weights cost 1 byte/param in HBM, adapters stay bf16/fp32.
         self.weight_only_quant = weight_only_quant
+        # Scalar family hooks (Granite-style multipliers); 1.0/None are
+        # constant-folded by XLA so the shared decoder pays nothing.
+        self._embedding_scale = 1.0     # embeds *= this after lookup
+        self._residual_scale = 1.0      # resid + this * block_out
+        self._attn_softmax_scale = None  # None -> head_dim ** -0.5
+        self._logits_divisor = 1.0      # logits /= this
         # Resolved sliding window for the shared attention core (uniform
         # across layers; per-layer window/full mixes are the Gemma families'
         # own forward).
@@ -401,6 +407,7 @@ class LlamaForCausalLM:
                         kv_cache, cache_index, local_window_size=None):
         """Train/prefill/decode attention + cache update on rotated q/k."""
         S = q.shape[1]
+        scale = self._attn_softmax_scale
         if kv_cache is not None:
             # Autoregressive decode: write this step's k/v into the static
             # [B, S_max, Hk, D] cache.  Prefill (S > 1) attends only over
@@ -421,19 +428,20 @@ class LlamaForCausalLM:
                     q, k, v, causal=True,
                     attention_mask=(None if attention_mask is None
                                     else attention_mask[:, :S]),
-                    local_window_size=local_window_size)
+                    scale=scale, local_window_size=local_window_size)
             else:
                 attn = cached_attention(
                     q, k_cache, v_cache,
                     cache_index=cache_index, q_len=S,
                     attention_mask=attention_mask,
-                    local_window_size=local_window_size)
+                    scale=scale, local_window_size=local_window_size)
             return attn, new_cache
         attn = attention(
             q, k, v,
             causal=True,
             segment_ids=segment_ids,
             attention_mask=attention_mask,
+            scale=scale,
             local_window_size=local_window_size,
         )
         return attn, None
@@ -466,12 +474,16 @@ class LlamaForCausalLM:
         attn = checkpoint_name(attn, "attn_core")
         attn = proj(attn.reshape(B, S, Hq * D), p["self_attn"]["o_proj"],
                     "self_attn.o_proj")
+        if self._residual_scale != 1.0:
+            attn = attn * self._residual_scale
         hidden = resid + attn
 
         # MLP block (dense SwiGLU here; MoE families override ``_mlp_block``)
         resid = hidden
         x = self._norm(hidden, p["post_attention_layernorm"], cfg.rms_norm_eps)
         down, moe_aux = self._mlp_block(x, p, proj)
+        if self._residual_scale != 1.0:
+            down = down * self._residual_scale
         # SP/CP activation layout between blocks (no-op without a sharding ctx)
         out = constrain(resid + down, ("act_batch", "act_seq", "act_embed"))
         return out, new_cache, moe_aux
@@ -520,6 +532,9 @@ class LlamaForCausalLM:
         ``automodel_tpu/generation``) — the result carries the updated cache
         under ``"kv_cache"``."""
         hidden = params["embed_tokens"]["embedding"][input_ids].astype(self.compute_dtype)
+        if self._embedding_scale != 1.0:
+            hidden = hidden * jnp.asarray(self._embedding_scale,
+                                          self.compute_dtype)
         return self.forward_embeds(
             params, hidden, position_ids=position_ids,
             segment_ids=segment_ids, attention_mask=attention_mask,
@@ -639,9 +654,17 @@ class LlamaForCausalLM:
         if return_hidden:
             out = {"hidden_states": hidden}
             if lm_kernel is not None:
+                if self._logits_divisor != 1.0:
+                    # fold the divisor into the head so the fused-CE path
+                    # sees the scaled logits too
+                    lm_kernel = lm_kernel / jnp.asarray(
+                        self._logits_divisor, lm_kernel.dtype)
                 out["lm_head_kernel"] = lm_kernel
         else:
             logits = hidden @ lm_kernel.astype(self.compute_dtype)
+            if self._logits_divisor != 1.0:
+                logits = logits / jnp.asarray(self._logits_divisor,
+                                              logits.dtype)
             out = {"logits": constrain(
                 logits, ("act_batch", "act_seq_nosp", "act_vocab"))}
         if aux_losses is not None:

@@ -167,3 +167,8 @@ def _ensure_builtin() -> None:
                                Starcoder2ForCausalLM,
                                hf_io.starcoder2_key_map,
                                ["Starcoder2ForCausalLM"]))
+    from automodel_tpu.models.granite import GraniteConfig, GraniteForCausalLM
+
+    # llama key map verbatim: Granite's deltas are scalars, not tensors
+    register_model(ModelFamily("granite", GraniteConfig, GraniteForCausalLM,
+                               hf_io.llama_key_map, ["GraniteForCausalLM"]))

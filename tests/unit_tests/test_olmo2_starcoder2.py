@@ -1,7 +1,6 @@
 """HF parity for the round-5 day-0 breadth families: OLMo-2 (post-norm +
-full-width q/k norms) and StarCoder-2 (LayerNorm + biased GELU MLP) —
-the two non-DeepSeek architectures VERDICT r4 named as registry gaps.
-"""
+full-width q/k norms), StarCoder-2 (LayerNorm + biased GELU MLP, sliding
+window), and Granite (muP-style scalar multipliers)."""
 
 import json
 import os
@@ -16,6 +15,7 @@ transformers = pytest.importorskip("transformers")
 
 from automodel_tpu.loss.masked_ce import cross_entropy_sum
 from automodel_tpu.models.olmo2 import Olmo2Config, Olmo2ForCausalLM
+from automodel_tpu.models.granite import GraniteConfig, GraniteForCausalLM
 from automodel_tpu.models.starcoder2 import (
     Starcoder2Config,
     Starcoder2ForCausalLM,
@@ -49,8 +49,20 @@ def _starcoder2_sliding_case():
     return cfg, Starcoder2ForCausalLM
 
 
+def _granite_case():
+    cfg = GraniteConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, tie_word_embeddings=True,
+        max_position_embeddings=64,
+        embedding_multiplier=12.0, attention_multiplier=0.03,
+        residual_multiplier=0.22, logits_scaling=8.0)
+    return cfg, GraniteForCausalLM
+
+
 CASES = {"olmo2": _olmo2_case, "starcoder2": _starcoder2_case,
-         "starcoder2_sliding": _starcoder2_sliding_case}
+         "starcoder2_sliding": _starcoder2_sliding_case,
+         "granite": _granite_case}
 
 
 def _randomized(model, key):
@@ -136,3 +148,20 @@ def test_hf_roundtrip_bitwise(name, tmp_path):
     restored = load_hf_weights(model, str(tmp_path))
     for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_granite_logits_scaling_reaches_fused_ce_path():
+    """The logits divisor must fold into lm_head_kernel on the
+    return_hidden (fused linear-CE) path, matching the logits path."""
+    cfg, cls = CASES["granite"]()
+    model = cls(cfg, param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                remat=False)
+    params = _randomized(model, jax.random.key(9))
+    ids = np.random.default_rng(1).integers(3, 256, (2, 16)).astype(np.int32)
+    full = model(params, jnp.asarray(ids))["logits"]
+    hid = model(params, jnp.asarray(ids), return_hidden=True)
+    via_head = hid["hidden_states"] @ hid["lm_head_kernel"].astype(
+        hid["hidden_states"].dtype)
+    np.testing.assert_allclose(np.asarray(full, np.float32),
+                               np.asarray(via_head, np.float32),
+                               atol=1e-4, rtol=1e-4)
