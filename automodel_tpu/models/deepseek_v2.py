@@ -1,0 +1,63 @@
+"""DeepSeek-V2 family (HF ``model_type: deepseek_v2``, e.g. V2-Lite).
+
+Parity target: ``transformers/models/deepseek_v2/modeling_deepseek_v2.py``.
+Same MLA attention and dense/MoE split stacks as the V3 family (V2's
+complex-number rope IS the interleaved rotation the V3 path implements —
+the pair permutation cancels inside the attention inner products), with
+the V2 gate instead of the V3 aux-free router: SOFTMAX scores, ``greedy``
+(V2-Lite) or ``group_limited_greedy`` (per-group MAX) top-k, combine
+weights = selected scores x routed_scaling_factor with no renorm and no
+``e_score_correction_bias`` parameter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.deepseek_v3 import (
+    DeepseekV3Config,
+    DeepseekV3ForCausalLM,
+)
+from automodel_tpu.ops.moe import softmax_group_topk_routing
+
+
+@dataclasses.dataclass
+class DeepseekV2Config(DeepseekV3Config):
+    topk_method: str = "greedy"
+    # accepted for HF-config compat; the HF modeling port computes no aux
+    aux_loss_alpha: float = 0.001
+    seq_aux: bool = True
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.model_type = "deepseek_v2"
+
+
+class DeepseekV2ForCausalLM(DeepseekV3ForCausalLM):
+    """``model_type: deepseek_v2`` — MLA x softmax-gated MoE."""
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        params = super().init(key)
+        if "layers" in params:      # V2 gate carries no correction bias
+            params["layers"]["mlp"]["gate"].pop("e_score_correction_bias")
+        return params
+
+    def param_axes(self) -> Dict[str, Any]:
+        axes = super().param_axes()
+        if "layers" in axes:
+            axes["layers"]["mlp"]["gate"].pop("e_score_correction_bias")
+        return axes
+
+    def _route(self, xg, gate_p, k):
+        cfg = self.config
+        scores = jax.nn.softmax(
+            xg.astype(jnp.float32)
+            @ gate_p["kernel"].astype(jnp.float32), axis=-1)
+        return softmax_group_topk_routing(
+            scores, k, topk_method=cfg.topk_method,
+            n_group=cfg.n_group, topk_group=cfg.topk_group,
+            routed_scaling_factor=float(cfg.routed_scaling_factor))

@@ -1,4 +1,6 @@
-"""DeepSeek-V2/V3 family (HF ``model_type: deepseek_v3``): MLA + no-aux MoE.
+"""DeepSeek-V3 family (HF ``model_type: deepseek_v3``): MLA + no-aux MoE.
+(DeepSeek-V2's softmax gate lives in ``models/deepseek_v2.py``, subclassing
+this module's attention/stack machinery via the ``_route`` hook.)
 
 The reference trains these through HF transformers
 (``nemo_automodel/components/_transformers/auto_model.py:384``); parity
@@ -384,6 +386,19 @@ class DeepseekV3ForCausalLM(LlamaForCausalLM):
         up = x @ p["up_proj"]["kernel"].astype(cd)
         return (jax.nn.silu(gate) * up) @ p["down_proj"]["kernel"].astype(cd)
 
+    def _route(self, xg, gate_p, k):
+        """Router hook: V3 sigmoid + aux-free bias correction; the V2
+        family overrides with softmax gating."""
+        cfg = self.config
+        scores = jax.nn.sigmoid(
+            xg.astype(jnp.float32)
+            @ gate_p["kernel"].astype(jnp.float32))
+        return noaux_topk_routing(
+            scores, gate_p["e_score_correction_bias"], k,
+            n_group=cfg.n_group, topk_group=cfg.topk_group,
+            norm_topk=bool(cfg.norm_topk_prob),
+            routed_scaling_factor=float(cfg.routed_scaling_factor))
+
     def _moe_mlp(self, x, p):
         cfg = self.config
         B, S, H = x.shape
@@ -394,13 +409,7 @@ class DeepseekV3ForCausalLM(LlamaForCausalLM):
                                   cfg.moe_capacity_factor)
         G = T // M
         xg = constrain(x.reshape(G, M, H), ("act_tokens", None, None))
-        scores = jax.nn.sigmoid(
-            xg.astype(jnp.float32) @ p["gate"]["kernel"].astype(jnp.float32))
-        weights, idx = noaux_topk_routing(
-            scores, p["gate"]["e_score_correction_bias"], k,
-            n_group=cfg.n_group, topk_group=cfg.topk_group,
-            norm_topk=bool(cfg.norm_topk_prob),
-            routed_scaling_factor=float(cfg.routed_scaling_factor))
+        weights, idx = self._route(xg, p["gate"], k)
         routed = expert_dispatch_ffn(
             xg, weights, idx,
             p["experts"]["gate_proj"]["kernel"],

@@ -142,6 +142,14 @@ def qwen3_moe_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
     return m
 
 
+def deepseek_v2_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
+    """DeepSeek-V2: the V3 map without the correction-bias tensor (the V2
+    softmax gate has none)."""
+    m = deepseek_v3_key_map(config)
+    m.pop(("layers", "mlp", "gate", "e_score_correction_bias"), None)
+    return m
+
+
 def olmo2_key_map(config) -> Dict[Tuple[str, ...], HfSpec]:
     """OLMo-2 (HF ``Olmo2ForCausalLM``): llama projections, post-norm
     layout (post_attention + post_feedforward norms), full-width q/k

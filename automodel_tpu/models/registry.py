@@ -154,6 +154,15 @@ def _ensure_builtin() -> None:
                                DeepseekV3ForCausalLM,
                                hf_io.deepseek_v3_key_map,
                                ["DeepseekV3ForCausalLM"]))
+    from automodel_tpu.models.deepseek_v2 import (
+        DeepseekV2Config,
+        DeepseekV2ForCausalLM,
+    )
+
+    register_model(ModelFamily("deepseek_v2", DeepseekV2Config,
+                               DeepseekV2ForCausalLM,
+                               hf_io.deepseek_v2_key_map,
+                               ["DeepseekV2ForCausalLM"]))
     from automodel_tpu.models.olmo2 import Olmo2Config, Olmo2ForCausalLM
 
     register_model(ModelFamily("olmo2", Olmo2Config, Olmo2ForCausalLM,

@@ -217,3 +217,38 @@ def noaux_topk_routing(
     if norm_topk:
         weights = weights / (jnp.sum(weights, axis=-1, keepdims=True) + 1e-20)
     return weights * routed_scaling_factor, idx
+
+
+def softmax_group_topk_routing(
+    scores: jnp.ndarray,      # [..., E] f32 SOFTMAX scores
+    k: int,
+    *,
+    topk_method: str = "greedy",
+    n_group: int = 1,
+    topk_group: int = 1,
+    routed_scaling_factor: float = 1.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """DeepSeek-V2 gate (HF ``DeepseekV2MoEGate``): softmax scores;
+    ``greedy`` = plain top-k (V2-Lite), ``group_limited_greedy`` = per-group
+    MAX score ranks groups, only the top ``topk_group`` groups stay
+    eligible (masked to 0.0, matching HF ``masked_fill``).  Combine
+    weights are the selected scores times ``routed_scaling_factor`` —
+    V2 does NOT renormalize the top-k mass.
+
+    Returns ``(weights [..., k], idx [..., k])``.
+    """
+    E = scores.shape[-1]
+    if topk_method == "greedy":
+        weights, idx = lax.top_k(scores, k)
+    elif topk_method == "group_limited_greedy":
+        gs = scores.reshape(*scores.shape[:-1], n_group, E // n_group)
+        group_score = jnp.max(gs, axis=-1)                    # [..., n_group]
+        _, gidx = lax.top_k(group_score, topk_group)
+        gmask = jnp.sum(
+            jax.nn.one_hot(gidx, n_group, dtype=scores.dtype), axis=-2)
+        masked = jnp.where(gmask[..., :, None] > 0, gs, 0.0).reshape(
+            scores.shape)
+        weights, idx = lax.top_k(masked, k)
+    else:
+        raise NotImplementedError(f"topk_method {topk_method!r}")
+    return weights * routed_scaling_factor, idx

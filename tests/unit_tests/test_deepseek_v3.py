@@ -186,3 +186,40 @@ def test_greedy_generate_matches_transformers(tmp_path):
         hf_out = hf.generate(torch.from_numpy(prompt), max_new_tokens=6,
                              do_sample=False, pad_token_id=0)
     np.testing.assert_array_equal(ours[0], hf_out[0, 9:].numpy())
+
+
+# ---------------------------------------------------------------------------
+# DeepSeek-V2 (softmax gate) family
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("topk", ["greedy", "group_limited_greedy"])
+def test_deepseek_v2_logits_match_transformers(tmp_path, topk):
+    from automodel_tpu.models.deepseek_v2 import (
+        DeepseekV2Config,
+        DeepseekV2ForCausalLM,
+    )
+
+    cfg = DeepseekV2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=4,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        max_position_embeddings=64, q_lora_rank=24, kv_lora_rank=32,
+        qk_rope_head_dim=8, qk_nope_head_dim=16, v_head_dim=16,
+        n_routed_experts=8, num_experts_per_tok=2, n_shared_experts=2,
+        moe_intermediate_size=48, first_k_dense_replace=1,
+        moe_capacity_factor=None, topk_method=topk,
+        routed_scaling_factor=1.0,
+        **({"n_group": 4, "topk_group": 2}
+           if topk == "group_limited_greedy" else {}))
+    model = DeepseekV2ForCausalLM(cfg, param_dtype=jnp.float32,
+                                  compute_dtype=jnp.float32, remat=False)
+    params = _randomized(model, jax.random.key(1))
+    hf = _export(model, params, tmp_path)
+
+    rng = np.random.default_rng(0)
+    B, S = 2, 24
+    input_ids = rng.integers(3, cfg.vocab_size, (B, S), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(input_ids)).logits.numpy()
+    out = model(params, jnp.asarray(input_ids.astype(np.int32)))
+    logits = np.asarray(out["logits"], dtype=np.float32)
+    np.testing.assert_allclose(logits, hf_logits, atol=3e-4, rtol=3e-3)
