@@ -1,0 +1,3 @@
+"""TPU-native (JAX/XLA/Pallas) AutoModel fine-tuning and pre-training."""
+
+__version__ = "0.1.0"  # keep in sync with pyproject.toml

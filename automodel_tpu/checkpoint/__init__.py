@@ -1,0 +1,28 @@
+"""Crash-safe checkpoint subsystem — public surface.
+
+See ``checkpointing.py`` for the atomic ``.tmp``-stage -> barrier ->
+manifest -> rename commit protocol, integrity manifest, retention GC and
+transient-I/O retry, and ``docs/guides/checkpointing.md`` for the operator
+view.
+"""
+
+from automodel_tpu.checkpoint.checkpointing import (  # noqa: F401
+    MANIFEST_NAME,
+    CheckpointFormat,
+    CheckpointIntegrityError,
+    CheckpointSaveError,
+    CheckpointingConfig,
+    adopt_legacy_checkpoint,
+    build_checkpoint_config,
+    commit_checkpoint,
+    find_latest_checkpoint,
+    gc_checkpoints,
+    is_committed,
+    list_committed_checkpoints,
+    prepare_staging,
+    read_manifest,
+    retry_io,
+    staging_path,
+    verify_manifest,
+    write_manifest,
+)

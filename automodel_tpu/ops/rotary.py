@@ -60,9 +60,12 @@ def rope_parameters(
     elif rope_type == "yarn":
         # HF _compute_yarn_parameters: blend interpolated (long-context)
         # and extrapolated (original) frequencies over a correction ramp.
+        # old_len precedence matches HF exactly: the rope_scaling dict's own
+        # original_max key, else max_position_embeddings — HF does NOT
+        # consult a config-level original_max_position_embeddings for yarn
+        # (only longrope does, below), so neither do we.
         factor = scaling["factor"]
         old_len = (scaling.get("original_max_position_embeddings")
-                   or original_max_position_embeddings
                    or max_position_embeddings)
         beta_fast = scaling.get("beta_fast") or 32.0
         beta_slow = scaling.get("beta_slow") or 1.0

@@ -41,7 +41,11 @@ from automodel_tpu.recipes.base_recipe import BaseRecipe
 from automodel_tpu.training.rng import StatefulRNG
 from automodel_tpu.training.step_scheduler import StepScheduler
 from automodel_tpu.training.timers import Timers, build_profiling_config
-from automodel_tpu.training.train_step import build_train_step, stack_microbatches
+from automodel_tpu.training.train_step import (
+    _PACKED_KEYS,
+    build_train_step,
+    stack_microbatches,
+)
 from automodel_tpu.training.utils import count_tokens
 
 logger = logging.getLogger(__name__)
@@ -548,10 +552,11 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
     def _finalize_metrics(self, pending) -> Dict[str, Any]:
         dmv = pending["device_metrics"]
         if "_packed" in dmv:
-            # single d2h transfer for all scalars (see train_step.py)
+            # single d2h transfer for all scalars; element order is owned by
+            # train_step._PACKED_KEYS (f32 buffer — token counts exact below
+            # 2^24 per step, see the list's comment)
             vals = jax.device_get(dmv["_packed"])
-            dm = {"loss": float(vals[0]), "grad_norm": float(vals[1]),
-                  "num_label_tokens": float(vals[2])}
+            dm = {k: float(v) for k, v in zip(_PACKED_KEYS, vals)}
         else:
             dm = jax.device_get(dmv)
         dt = time.perf_counter() - pending["t_dispatch"]
@@ -726,9 +731,26 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                     if (self.checkpoint_config.enabled
                             and getattr(self, "_last_ckpt_step", -1)
                             != sched.step):
-                        self.save_checkpoint(epoch, sched.step)
-                        self._last_ckpt_step = sched.step
-                        saved = True
+                        # Grace-window save: if it fails (preemption kill
+                        # landing mid-write, exhausted I/O retries), exit
+                        # cleanly anyway — the atomic commit protocol means
+                        # a failed save left only a .tmp dir and the last
+                        # COMMITTED checkpoint is still what resume finds.
+                        # Multi-host caveat: a host-local failure leaves the
+                        # peers blocked at the commit barrier until the
+                        # preemptor's hard kill — acceptable here because
+                        # the whole pool is being torn down regardless; the
+                        # point of the catch is the state guarantee, not
+                        # saving the doomed processes.
+                        try:
+                            self.save_checkpoint(epoch, sched.step)
+                            self._last_ckpt_step = sched.step
+                            saved = True
+                        except Exception:
+                            logger.exception(
+                                "preemption checkpoint at step %d failed; "
+                                "resume will use the last committed "
+                                "checkpoint", sched.step)
                     self._preempt_saved = (
                         saved or getattr(self, "_last_ckpt_step", -1)
                         == sched.step)

@@ -11,6 +11,11 @@ an AutoProcessor + VLM collator instead of a tokenizer, and the default
 freeze policy masks embeddings/vision tower via the optax trainable-mask
 instead of ``requires_grad`` surgery.  The jitted train step is shared; VLM
 batches simply carry ``pixel_values`` which the step shards over dp.
+
+Checkpointing (the full ``checkpoint:`` YAML surface — atomic commit,
+``restore_from``, ``keep_last_k``/``keep_every_n_steps`` retention,
+``io_retries``) is inherited unchanged from ``BaseRecipe`` via the LLM
+recipe; see ``docs/guides/checkpointing.md``.
 """
 
 from __future__ import annotations

@@ -44,6 +44,15 @@ _MODEL_KEYS = ("input_ids", "position_ids", "segment_ids", "attention_mask",
 # Keys the step itself consumes outside the model forward.
 _STEP_KEYS = ("labels", "dropout_rng")
 
+# Order contract for the fused ``metrics["_packed"]`` device buffer: packed
+# here, unpacked by ``recipes/llm/train_ft.py::_finalize_metrics`` — both
+# sites MUST iterate this one list, so adding a metric cannot silently
+# desynchronize them.  Everything rides as float32 (one dtype, one d2h
+# transfer); note ``num_label_tokens`` is therefore exact only below 2^24
+# (~16.7M) label tokens per optimizer step — beyond that, carry it as a
+# separate int32 leaf instead of widening this buffer.
+_PACKED_KEYS = ("loss", "grad_norm", "num_label_tokens")
+
 
 def _model_keys(model) -> Tuple[str, ...]:
     return _MODEL_KEYS + tuple(getattr(model, "extra_batch_keys", ()))
@@ -276,8 +285,7 @@ def build_train_step(
         # device idle per step to exactly this), while "_packed" fetches
         # everything in a single transfer.
         metrics["_packed"] = jnp.stack(
-            [metrics["loss"], metrics["grad_norm"],
-             num_label_tokens.astype(jnp.float32)])
+            [metrics[k].astype(jnp.float32) for k in _PACKED_KEYS])
         return params, opt_state, metrics
 
     def eval_step(params, batch):

@@ -15,11 +15,37 @@ import contextlib
 import jax
 
 
-def _barrier(tag: str) -> None:
+def barrier(tag: str) -> None:
+    """Cross-process sync point (no-op single-process).  COLLECTIVE: every
+    process must reach it with the same tag — the checkpoint commit protocol
+    uses it to order "all writers finished" before "process 0 renames"."""
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(tag)
+
+
+def all_hosts_ok(ok: bool, tag: str = "all_hosts_ok") -> bool:
+    """True iff EVERY process reports ``ok``.  COLLECTIVE: all processes
+    must call it (so it also acts as a sync point).  The checkpoint save
+    path uses it to agree on aborting a commit when any host's I/O failed —
+    the failing host catches its error and votes instead of raising past a
+    barrier, which would leave peers hanging in it.  ``tag`` names the vote
+    in the failure log (the allgather itself carries no tag)."""
+    if jax.process_count() == 1:
+        return bool(ok)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(np.asarray([bool(ok)]))
+    if not np.all(flags):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "collective vote %r failed on process(es) %s",
+            tag, np.nonzero(~flags.reshape(-1))[0].tolist())
+        return False
+    return True
 
 
 @contextlib.contextmanager
@@ -36,10 +62,10 @@ def first_rank_first(tag: str = "first_rank_first"):
     """
     is_leader = jax.process_index() == 0
     if not is_leader:
-        _barrier(f"{tag}:leader_done")
+        barrier(f"{tag}:leader_done")
     try:
         yield is_leader
     finally:
         if is_leader:
-            _barrier(f"{tag}:leader_done")
-        _barrier(f"{tag}:all_done")
+            barrier(f"{tag}:leader_done")
+        barrier(f"{tag}:all_done")

@@ -1,0 +1,145 @@
+"""Deterministic fault-injection harness for crash-safety testing.
+
+Named ``fault_point("...")`` call sites mark the places where a preemption
+kill or an I/O failure would be most damaging (the checkpoint save path
+threads them through ``recipes/base_recipe.py`` and
+``checkpoint/checkpointing.py``).  In production every ``fault_point`` is a
+dict lookup that misses — effectively free.  Under test, a spec arms a point
+to fire on its N-th hit, either raising :class:`InjectedFault` (in-process
+tests) or hard-exiting the process (subprocess kill simulation — no cleanup,
+no ``atexit``, exactly like a TPU-pool preemption SIGKILL).
+
+Spec grammar (config API or the ``AUTOMODEL_FAULT`` env var)::
+
+    AUTOMODEL_FAULT="ckpt_pre_commit:1"          # raise on 1st hit
+    AUTOMODEL_FAULT="ckpt_pre_rename:2:kill"     # os._exit on 2nd hit
+    AUTOMODEL_FAULT="a:1,b:3"                    # multiple points
+
+Each entry is ``name[:count][:mode]`` — ``count`` defaults to 1 (fire on the
+first hit), ``mode`` is ``raise`` (default) or ``kill``/``exit``.  A point
+fires exactly once, on exactly the ``count``-th hit: deterministic by
+construction, no randomness anywhere.
+
+Registered checkpoint-path points (see ``BaseRecipe.save_checkpoint``):
+
+    ckpt_pre_save     before the staging directory is prepared
+    ckpt_pre_commit   after all state is written, before the manifest
+    ckpt_pre_rename   after the manifest, before the atomic rename
+    ckpt_post_commit  after the rename, before retention GC
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Dict, Optional
+
+FAULT_ENV = "AUTOMODEL_FAULT"
+_KILL_EXIT_CODE = 113  # distinctive, so subprocess tests can assert on it
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed fault point (``mode=raise``)."""
+
+
+@dataclasses.dataclass
+class FaultPoint:
+    """One armed crash site: fires once, on the ``trigger_at``-th hit."""
+
+    name: str
+    trigger_at: int = 1
+    mode: str = "raise"  # "raise" | "kill"
+    hits: int = 0
+    fired: bool = False
+
+
+_lock = threading.Lock()
+_registry: Dict[str, FaultPoint] = {}
+_env_loaded = False
+
+
+def parse_fault_spec(spec: str) -> Dict[str, FaultPoint]:
+    """``"name[:count][:mode],..."`` -> name -> :class:`FaultPoint`."""
+    points: Dict[str, FaultPoint] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        name = parts[0]
+        if not name:
+            raise ValueError(f"fault spec entry {entry!r} has no point name")
+        trigger_at = int(parts[1]) if len(parts) > 1 and parts[1] else 1
+        if trigger_at < 1:
+            raise ValueError(
+                f"fault spec {entry!r}: count must be >= 1 (1-based hits)")
+        mode = parts[2].lower() if len(parts) > 2 and parts[2] else "raise"
+        if mode == "exit":
+            mode = "kill"
+        if mode not in ("raise", "kill"):
+            raise ValueError(
+                f"fault spec {entry!r}: mode must be raise|kill, got {mode!r}")
+        points[name] = FaultPoint(name=name, trigger_at=trigger_at, mode=mode)
+    return points
+
+
+def configure_faults(spec: Optional[str]) -> None:
+    """Arm the registry from a spec string (replaces any prior config);
+    ``None``/empty disarms everything.  Marks the env as consumed so a stale
+    ``AUTOMODEL_FAULT`` cannot resurrect points after an explicit call."""
+    global _env_loaded
+    with _lock:
+        _registry.clear()
+        _env_loaded = True
+        if spec:
+            _registry.update(parse_fault_spec(spec))
+
+
+def reset_faults() -> None:
+    """Disarm everything (test teardown)."""
+    configure_faults(None)
+
+
+def _ensure_env_loaded() -> None:
+    global _env_loaded
+    if _env_loaded:
+        return
+    with _lock:
+        if _env_loaded:
+            return
+        _env_loaded = True
+        spec = os.environ.get(FAULT_ENV)
+        if spec:
+            _registry.update(parse_fault_spec(spec))
+
+
+def fault_point(name: str) -> None:
+    """Mark a named crash site.  No-op unless a spec armed ``name``."""
+    _ensure_env_loaded()
+    if not _registry:
+        return
+    with _lock:
+        fp = _registry.get(name)
+        if fp is None:
+            return
+        fp.hits += 1
+        should_fire = not fp.fired and fp.hits == fp.trigger_at
+        if should_fire:
+            fp.fired = True
+        mode = fp.mode
+        hits = fp.hits
+    if not should_fire:
+        return
+    if mode == "kill":
+        # Simulate a hard preemption kill: no unwinding, no atexit, no
+        # buffered-file flush — the checkpoint commit protocol must make
+        # this indistinguishable from pulling the plug.
+        os._exit(_KILL_EXIT_CODE)
+    raise InjectedFault(f"injected fault at {name!r} (hit {hits})")
+
+
+def fault_counts() -> Dict[str, int]:
+    """Observed hit counts per armed point (test assertions)."""
+    with _lock:
+        return {name: fp.hits for name, fp in _registry.items()}

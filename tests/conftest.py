@@ -57,6 +57,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "core: keep in the fast tier even inside a slow module "
         "(one cheap end-to-end representative per major code path)")
+    config.addinivalue_line(
+        "markers", "fault: fault-injection crash-safety tests (CPU-only and "
+        "fast — they run in the tier-1 core suite; select with -m fault)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,145 @@
+"""Checkpoint discovery edge cases: malformed names, empty roots, staging
+leftovers, (epoch, step) tie-breaking, and explicit ``restore_from`` targets
+pointing at uncommitted dirs."""
+
+import os
+
+import pytest
+
+from automodel_tpu.checkpoint import checkpointing as ckpt
+from automodel_tpu.recipes.base_recipe import BaseRecipe
+
+
+def _commit(root, epoch, step, payload=b"x"):
+    """Hand-build a committed checkpoint dir (payload file + manifest)."""
+    path = os.path.join(str(root), ckpt.checkpoint_dir_name(epoch, step))
+    os.makedirs(path)
+    with open(os.path.join(path, "state.pt"), "wb") as f:
+        f.write(payload)
+    ckpt.write_manifest(path, epoch=epoch, step=step)
+    return path
+
+
+def test_missing_and_empty_roots(tmp_path):
+    assert ckpt.find_latest_checkpoint(str(tmp_path / "nope")) is None
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) is None
+    assert ckpt.list_committed_checkpoints(str(tmp_path)) == []
+
+
+def test_malformed_names_are_skipped(tmp_path):
+    for name in ("epoch_x_step_2", "epoch_1_step_", "step_5_epoch_1",
+                 "checkpoint-000123", "epoch_1_step_2_extra"):
+        os.makedirs(tmp_path / name)
+    good = _commit(tmp_path, 0, 1)
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) == good
+
+
+def test_stray_file_with_checkpoint_name_is_skipped(tmp_path):
+    (tmp_path / "epoch_9_step_9").write_text("not a directory")
+    good = _commit(tmp_path, 0, 1)
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) == good
+
+
+def test_staging_and_gc_leftovers_are_skipped(tmp_path):
+    good = _commit(tmp_path, 0, 5)
+    # a newer but uncommitted staging dir and a GC husk must both lose
+    os.makedirs(tmp_path / "epoch_0_step_6.tmp")
+    os.makedirs(tmp_path / "epoch_0_step_7.gc.tmp")
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) == good
+
+
+def test_manifestless_dir_skipped_with_fallback(tmp_path):
+    """A half-written final-name dir (pre-protocol legacy or torn copy) is
+    not selectable; discovery falls back to the newest COMMITTED one."""
+    committed = _commit(tmp_path, 0, 5)
+    bare = tmp_path / "epoch_0_step_10"
+    os.makedirs(bare / "model")
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) == committed
+    assert [p for _, _, p in ckpt.list_committed_checkpoints(str(tmp_path))] \
+        == [committed]
+
+
+def test_numeric_tie_breaking_epoch_dominates(tmp_path):
+    _commit(tmp_path, 0, 50)
+    best = _commit(tmp_path, 1, 5)
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) == best
+
+
+def test_numeric_not_lexicographic_step_ordering(tmp_path):
+    _commit(tmp_path, 0, 9)
+    best = _commit(tmp_path, 0, 10)  # lexicographically smaller, numerically larger
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) == best
+
+
+class _Recipe(BaseRecipe):
+    def __init__(self, ckpt_dir, restore_from=None):
+        super().__init__()
+        self.checkpoint_config = ckpt.CheckpointingConfig(
+            checkpoint_dir=str(ckpt_dir), restore_from=restore_from)
+
+
+def test_restore_from_uncommitted_dir_raises(tmp_path):
+    bare = tmp_path / "epoch_0_step_3"
+    os.makedirs(bare / "model")
+    with pytest.raises(ckpt.CheckpointIntegrityError, match="never"):
+        _Recipe(tmp_path).load_checkpoint(restore_from=str(bare))
+
+
+def test_restore_from_staging_dir_raises(tmp_path):
+    staging = tmp_path / "epoch_0_step_3.tmp"
+    os.makedirs(staging)
+    with pytest.raises(ckpt.CheckpointIntegrityError, match="staging"):
+        _Recipe(tmp_path).load_checkpoint(restore_from=str(staging))
+
+
+def test_restore_from_missing_path_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        _Recipe(tmp_path).load_checkpoint(restore_from=str(tmp_path / "gone"))
+
+
+def test_restore_from_flows_from_config(tmp_path):
+    """checkpoint.restore_from in YAML reaches load_checkpoint (config
+    plumbing for explicit resume targets)."""
+    good = _commit(tmp_path, 0, 1)
+    assert _Recipe(tmp_path, restore_from=good).load_checkpoint() == good
+    # and a config-level target pointing at garbage fails loudly too
+    bad = tmp_path / "epoch_0_step_2"
+    os.makedirs(bad)
+    with pytest.raises(ckpt.CheckpointIntegrityError):
+        _Recipe(tmp_path, restore_from=str(bad)).load_checkpoint()
+
+
+def test_no_discovery_resume_when_nothing_committed(tmp_path):
+    os.makedirs(tmp_path / "epoch_0_step_1.tmp")
+    assert _Recipe(tmp_path).load_checkpoint() is None
+
+
+def test_adopt_legacy_checkpoint_makes_it_discoverable(tmp_path):
+    """Pre-protocol dirs are skipped until an operator explicitly adopts
+    them (the in-place upgrade path, tools/verify_checkpoint.py --adopt)."""
+    legacy = tmp_path / "epoch_0_step_7"
+    os.makedirs(legacy / "model")
+    (legacy / "model" / "weights.bin").write_bytes(b"w" * 16)
+    (legacy / "dataloader.pt").write_bytes(b"d")
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) is None
+    manifest = ckpt.adopt_legacy_checkpoint(str(legacy))
+    assert (manifest["epoch"], manifest["step"]) == (0, 7)
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) == str(legacy)
+    ckpt.verify_manifest(str(legacy))
+    # adopting an already-committed dir is an idempotent verify
+    ckpt.adopt_legacy_checkpoint(str(legacy))
+
+
+def test_adopt_rejects_staging_empty_and_malformed(tmp_path):
+    empty = tmp_path / "epoch_0_step_1"
+    os.makedirs(empty)
+    with pytest.raises(ckpt.CheckpointIntegrityError, match="empty"):
+        ckpt.adopt_legacy_checkpoint(str(empty))
+    staging = tmp_path / "epoch_0_step_2.tmp"
+    os.makedirs(staging)
+    with pytest.raises(ckpt.CheckpointIntegrityError, match="adoptable"):
+        ckpt.adopt_legacy_checkpoint(str(staging))
+    odd = tmp_path / "not_a_checkpoint"
+    os.makedirs(odd)
+    with pytest.raises(ckpt.CheckpointIntegrityError, match="adoptable"):
+        ckpt.adopt_legacy_checkpoint(str(odd))

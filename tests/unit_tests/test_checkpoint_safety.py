@@ -1,0 +1,344 @@
+"""Crash-safe checkpoint lifecycle: atomic commit, fault-injected interrupts,
+resume fallback, retention GC, and transient-I/O retry.
+
+The acceptance scenario (ISSUE 1): a fault injected between the state writes
+and the manifest commit must leave a staging dir that resume cannot see;
+resume lands on the previous committed checkpoint; the next clean save
+commits atomically and retention GC prunes per ``keep_last_k``.
+
+Uses a minimal ``BaseRecipe`` with host-side statefuls only (no Orbax/model
+collective saves) so the protocol is exercised end-to-end in milliseconds —
+the commit/GC/manifest code path is identical for the heavy writers.
+"""
+
+import json
+import os
+
+import pytest
+
+from automodel_tpu.checkpoint import checkpointing as ckpt
+from automodel_tpu.recipes.base_recipe import BaseRecipe
+from automodel_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.reset_faults()
+    yield
+    fi.reset_faults()
+
+
+class _Counter:
+    def __init__(self, value=0):
+        self.value = value
+
+    def state_dict(self):
+        return {"value": self.value}
+
+    def load_state_dict(self, sd):
+        self.value = sd["value"]
+
+
+class _TinyRecipe(BaseRecipe):
+    def __init__(self, ckpt_dir, **cfg_kw):
+        super().__init__()
+        self.checkpoint_config = ckpt.CheckpointingConfig(
+            checkpoint_dir=str(ckpt_dir), **cfg_kw)
+        self.counter = _Counter()
+
+
+def _dirs(root):
+    return sorted(os.listdir(root)) if os.path.isdir(root) else []
+
+
+# ---------------------------------------------------------------------------
+# Atomic commit
+# ---------------------------------------------------------------------------
+def test_clean_save_commits_atomically(tmp_path):
+    r = _TinyRecipe(tmp_path)
+    r.counter.value = 7
+    path = r.save_checkpoint(epoch=0, step=1)
+    assert os.path.basename(path) == "epoch_0_step_1"
+    assert _dirs(tmp_path) == ["epoch_0_step_1"]  # no .tmp leftovers
+    assert ckpt.is_committed(path)
+    manifest = ckpt.verify_manifest(path)
+    assert manifest["epoch"] == 0 and manifest["step"] == 1
+    listed = {e["path"] for e in manifest["files"]}
+    assert "counter.pt" in listed
+    entry = next(e for e in manifest["files"] if e["path"] == "counter.pt")
+    assert entry["sha256"] and entry["size"] > 0
+
+
+def test_resave_same_step_replaces_committed(tmp_path):
+    r = _TinyRecipe(tmp_path)
+    r.counter.value = 1
+    r.save_checkpoint(0, 1)
+    r.counter.value = 2
+    path = r.save_checkpoint(0, 1)
+    assert _dirs(tmp_path) == ["epoch_0_step_1"]
+    fresh = _TinyRecipe(tmp_path)
+    assert fresh.load_checkpoint() == path
+    assert fresh.counter.value == 2
+
+
+# ---------------------------------------------------------------------------
+# The acceptance scenario: interrupted save is invisible to resume
+# ---------------------------------------------------------------------------
+def test_interrupted_save_invisible_then_clean_save_gcs(tmp_path):
+    r = _TinyRecipe(tmp_path, keep_last_k=1)
+    r.counter.value = 10
+    committed_1 = r.save_checkpoint(0, 1)
+
+    # Kill between the state writes and the manifest commit.
+    fi.configure_faults("ckpt_pre_commit:1")
+    r.counter.value = 20
+    with pytest.raises(fi.InjectedFault):
+        r.save_checkpoint(0, 2)
+    assert "epoch_0_step_2.tmp" in _dirs(tmp_path)
+    assert "epoch_0_step_2" not in _dirs(tmp_path)
+
+    # Discovery skips the staging dir and falls back to the commit.
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) == committed_1
+
+    # Resume restores the previous committed checkpoint's state.
+    fi.reset_faults()
+    r2 = _TinyRecipe(tmp_path, keep_last_k=1)
+    assert r2.load_checkpoint() == committed_1
+    assert r2.counter.value == 10
+
+    # A subsequent clean save at the same step commits atomically (clearing
+    # the stale staging leftovers) ...
+    r2.counter.value = 11
+    committed_2 = r2.save_checkpoint(0, 2)
+    assert ckpt.is_committed(committed_2)
+    # ... keep_last_k=1 GC runs, but never deletes the resume source.
+    assert "epoch_0_step_1" in _dirs(tmp_path)
+    assert not any(d.endswith(".tmp") for d in _dirs(tmp_path))
+
+    # The next commit prunes the now-superseded step 2 (unprotected).
+    r2.counter.value = 12
+    r2.save_checkpoint(0, 3)
+    assert "epoch_0_step_2" not in _dirs(tmp_path)
+    assert "epoch_0_step_1" in _dirs(tmp_path)  # resume source still pinned
+    assert ckpt.find_latest_checkpoint(str(tmp_path)).endswith("epoch_0_step_3")
+
+
+def test_resave_interrupted_at_rename_preserves_old_payload(tmp_path):
+    """Replacing a committed checkpoint at the same (epoch, step) must not
+    rmtree it before the new one lands: a kill inside the rename window
+    leaves the old payload in a .gc.tmp husk (operator-recoverable), never
+    destroys it outright."""
+    r = _TinyRecipe(tmp_path)
+    r.counter.value = 1
+    r.save_checkpoint(0, 1)
+    fi.configure_faults("ckpt_pre_rename:1")
+    r.counter.value = 2
+    with pytest.raises(fi.InjectedFault):
+        r.save_checkpoint(0, 1)
+    # the old commit is still intact and discoverable (fault fired before
+    # it was set aside), the torn re-save is only a staging dir
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) is not None
+    fresh = _TinyRecipe(tmp_path)
+    fresh.load_checkpoint()
+    assert fresh.counter.value == 1
+
+
+def test_fault_after_manifest_before_rename_still_invisible(tmp_path):
+    """Even with the manifest already written, a kill before the rename
+    leaves only a .tmp dir — committed-ness is the final NAME, so there is
+    no window where a partial save is discoverable."""
+    r = _TinyRecipe(tmp_path)
+    fi.configure_faults("ckpt_pre_rename:1")
+    with pytest.raises(fi.InjectedFault):
+        r.save_checkpoint(0, 1)
+    staging = tmp_path / "epoch_0_step_1.tmp"
+    assert staging.is_dir()
+    assert (staging / ckpt.MANIFEST_NAME).is_file()
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) is None
+    assert _TinyRecipe(tmp_path).load_checkpoint() is None
+
+
+# ---------------------------------------------------------------------------
+# Retention GC
+# ---------------------------------------------------------------------------
+def test_gc_keep_last_k_with_milestone_pins(tmp_path):
+    r = _TinyRecipe(tmp_path, keep_last_k=1, keep_every_n_steps=10)
+    for step in (5, 10, 15):
+        r.save_checkpoint(0, step)
+    # keep_last_k=1 keeps step 15; step 10 is a milestone pin; 5 is GC'd
+    assert _dirs(tmp_path) == ["epoch_0_step_10", "epoch_0_step_15"]
+
+
+def test_gc_disabled_keeps_everything(tmp_path):
+    r = _TinyRecipe(tmp_path)  # keep_last_k=None
+    for step in (1, 2, 3):
+        r.save_checkpoint(0, step)
+    assert len(_dirs(tmp_path)) == 3
+
+
+def test_gc_sweeps_superseded_staging_leftovers(tmp_path):
+    r = _TinyRecipe(tmp_path, keep_last_k=2)
+    r.save_checkpoint(0, 1)
+    fi.configure_faults("ckpt_pre_commit:1")
+    with pytest.raises(fi.InjectedFault):
+        r.save_checkpoint(0, 2)
+    fi.reset_faults()
+    assert "epoch_0_step_2.tmp" in _dirs(tmp_path)
+    # the next commit outranks the dead staging dir -> swept
+    r.save_checkpoint(0, 3)
+    assert _dirs(tmp_path) == ["epoch_0_step_1", "epoch_0_step_3"]
+
+
+def test_gc_epoch_dominates_step_ordering(tmp_path):
+    r = _TinyRecipe(tmp_path, keep_last_k=1)
+    r.save_checkpoint(0, 50)
+    r.save_checkpoint(1, 5)  # later epoch, smaller step — this is newest
+    assert _dirs(tmp_path) == ["epoch_1_step_5"]
+
+
+# ---------------------------------------------------------------------------
+# Integrity verification on resume
+# ---------------------------------------------------------------------------
+def test_truncated_stateful_fails_resume_loudly(tmp_path):
+    r = _TinyRecipe(tmp_path)
+    path = r.save_checkpoint(0, 1)
+    pt = os.path.join(path, "counter.pt")
+    with open(pt, "rb") as f:
+        blob = f.read()
+    with open(pt, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    with pytest.raises(ckpt.CheckpointIntegrityError, match="epoch_0_step_1"):
+        _TinyRecipe(tmp_path).load_checkpoint()
+
+
+def test_same_size_corruption_caught_by_checksum(tmp_path):
+    r = _TinyRecipe(tmp_path)
+    path = r.save_checkpoint(0, 1)
+    pt = os.path.join(path, "counter.pt")
+    size = os.path.getsize(pt)
+    with open(pt, "wb") as f:
+        f.write(b"\x00" * size)
+    with pytest.raises(ckpt.CheckpointIntegrityError, match="sha256"):
+        ckpt.verify_manifest(path)
+    # shallow (size-only) verification accepts it — deep is the default
+    ckpt.verify_manifest(path, deep=False)
+
+
+def test_missing_manifest_file_entry_detected(tmp_path):
+    r = _TinyRecipe(tmp_path)
+    path = r.save_checkpoint(0, 1)
+    os.remove(os.path.join(path, "counter.pt"))
+    with pytest.raises(ckpt.CheckpointIntegrityError, match="missing"):
+        ckpt.verify_manifest(path)
+
+
+def test_malformed_manifest_surfaces_as_integrity_error(tmp_path):
+    """Bit-rotted manifest.json must fail as a named corrupt checkpoint,
+    not an opaque JSONDecodeError (tools/verify_checkpoint.py and
+    load_checkpoint both catch only CheckpointIntegrityError)."""
+    r = _TinyRecipe(tmp_path)
+    path = r.save_checkpoint(0, 1)
+    with open(os.path.join(path, ckpt.MANIFEST_NAME), "w") as f:
+        f.write('{"manifest_version": 1, "files": [truncated')
+    with pytest.raises(ckpt.CheckpointIntegrityError, match="valid JSON"):
+        ckpt.verify_manifest(path)
+    with pytest.raises(ckpt.CheckpointIntegrityError):
+        _TinyRecipe(tmp_path).load_checkpoint()
+
+
+def test_manifest_is_valid_json_with_schema(tmp_path):
+    path = _TinyRecipe(tmp_path).save_checkpoint(2, 9)
+    with open(os.path.join(path, ckpt.MANIFEST_NAME)) as f:
+        m = json.load(f)
+    assert m["manifest_version"] == ckpt.MANIFEST_VERSION
+    assert m["framework"] == "automodel_tpu"
+    assert (m["epoch"], m["step"]) == (2, 9)
+    assert m["format"] == "safetensors"
+    assert isinstance(m["files"], list) and m["files"]
+
+
+# ---------------------------------------------------------------------------
+# Transient-I/O retry
+# ---------------------------------------------------------------------------
+def test_retry_io_recovers_from_transient_failures():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("NFS hiccup")
+        return "ok"
+
+    assert ckpt.retry_io(flaky, retries=3, backoff=0.0) == "ok"
+    assert calls["n"] == 3
+
+
+def test_retry_io_exhausts_and_reraises():
+    def always_down():
+        raise OSError("still down")
+
+    with pytest.raises(OSError, match="still down"):
+        ckpt.retry_io(always_down, retries=2, backoff=0.0)
+
+
+def test_retry_io_does_not_retry_non_io_errors():
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise ValueError("a bug, not weather")
+
+    with pytest.raises(ValueError):
+        ckpt.retry_io(broken, retries=5, backoff=0.0)
+    assert calls["n"] == 1  # injected faults / bugs must not be retried
+
+
+def test_failed_host_writes_abort_commit_without_torn_state(tmp_path):
+    """Exhausted host-side writes abort the save with CheckpointSaveError
+    BEFORE the commit: no committed dir appears, the previous checkpoint
+    stays the resume target, and the next good save recovers."""
+
+    class _Broken:
+        def state_dict(self):
+            raise OSError("disk full")
+
+        def load_state_dict(self, sd):
+            pass
+
+    r = _TinyRecipe(tmp_path, io_retries=0)
+    r.counter.value = 1
+    good = r.save_checkpoint(0, 1)
+    r.broken = _Broken()
+    with pytest.raises(ckpt.CheckpointSaveError, match="aborting commit"):
+        r.save_checkpoint(0, 2)
+    assert "epoch_0_step_2" not in _dirs(tmp_path)
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) == good
+    del r._state_tracked["broken"]
+    assert ckpt.is_committed(r.save_checkpoint(0, 3))
+
+
+def test_save_retries_transient_stateful_write_failures(tmp_path, monkeypatch):
+    """End-to-end: a pickle write that fails twice with OSError still
+    produces a committed checkpoint under checkpoint.io_retries=3."""
+    import pickle as _pickle
+
+    real_dump = _pickle.dump
+    fails = {"n": 2}
+
+    def flaky_dump(obj, f, *a, **kw):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError("transient write failure")
+        return real_dump(obj, f, *a, **kw)
+
+    monkeypatch.setattr(
+        "automodel_tpu.checkpoint.checkpointing.pickle.dump", flaky_dump)
+    r = _TinyRecipe(tmp_path, io_retries=3, io_retry_backoff=0.0)
+    r.counter.value = 5
+    path = r.save_checkpoint(0, 1)
+    assert ckpt.is_committed(path)
+    fresh = _TinyRecipe(tmp_path)
+    fresh.load_checkpoint()
+    assert fresh.counter.value == 5

@@ -1,0 +1,81 @@
+"""Unit tests for the deterministic fault-injection harness
+(``automodel_tpu/utils/fault_injection.py``)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from automodel_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    fi.reset_faults()
+    yield
+    fi.reset_faults()
+
+
+def test_unarmed_point_is_noop():
+    for _ in range(3):
+        fi.fault_point("ckpt_pre_commit")  # must not raise
+
+
+def test_spec_parsing_defaults_and_modes():
+    points = fi.parse_fault_spec("a, b:3 ,c:2:kill,d::exit")
+    assert points["a"].trigger_at == 1 and points["a"].mode == "raise"
+    assert points["b"].trigger_at == 3
+    assert points["c"].mode == "kill" and points["c"].trigger_at == 2
+    assert points["d"].mode == "kill" and points["d"].trigger_at == 1
+
+
+@pytest.mark.parametrize("bad", ["a:0", "a:1:frobnicate", ":2"])
+def test_spec_parsing_rejects_garbage(bad):
+    with pytest.raises(ValueError):
+        fi.parse_fault_spec(bad)
+
+
+def test_fires_exactly_once_on_nth_hit():
+    fi.configure_faults("pt:3")
+    fi.fault_point("pt")
+    fi.fault_point("pt")
+    with pytest.raises(fi.InjectedFault):
+        fi.fault_point("pt")
+    # deterministic: hit 4+ never re-fires
+    fi.fault_point("pt")
+    assert fi.fault_counts() == {"pt": 4}
+
+
+def test_other_points_unaffected():
+    fi.configure_faults("armed:1")
+    fi.fault_point("different")
+    with pytest.raises(fi.InjectedFault):
+        fi.fault_point("armed")
+
+
+def test_reset_disarms():
+    fi.configure_faults("pt:1")
+    fi.reset_faults()
+    fi.fault_point("pt")  # must not raise
+    assert fi.fault_counts() == {}
+
+
+def test_env_spec_arms_fresh_process(subprocess_env):
+    """`AUTOMODEL_FAULT` drives a real child process; `kill` mode hard-exits
+    with the sentinel code (the preemption-kill simulation)."""
+    env = subprocess_env(1)
+    env[fi.FAULT_ENV] = "boom:2:kill"
+    code = (
+        "from automodel_tpu.utils.fault_injection import fault_point\n"
+        "fault_point('boom')\n"
+        "print('survived first hit')\n"
+        "fault_point('boom')\n"
+        "print('never reached')\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == fi._KILL_EXIT_CODE
+    assert "survived first hit" in proc.stdout
+    assert "never reached" not in proc.stdout

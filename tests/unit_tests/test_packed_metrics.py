@@ -1,0 +1,41 @@
+"""The fused device-metrics buffer contract (ADVICE r5): pack
+(``train_step._PACKED_KEYS``) and unpack (``train_ft._finalize_metrics``)
+must iterate the SAME ordered key list, so a metric added to one site
+cannot silently desynchronize the other."""
+
+import time
+import types
+
+import numpy as np
+
+from automodel_tpu.recipes.llm import train_ft
+from automodel_tpu.training import train_step
+
+
+def test_both_sites_share_one_key_list():
+    # identity, not equality: train_ft must IMPORT the list, not copy it
+    assert train_ft._PACKED_KEYS is train_step._PACKED_KEYS
+
+
+def test_packed_keys_cover_finalize_contract():
+    # _finalize_metrics reads these from the unpacked dict; if a key leaves
+    # the list, the recipe breaks — fail here first, with a clear message
+    assert {"loss", "grad_norm", "num_label_tokens"} <= set(
+        train_step._PACKED_KEYS)
+
+
+def test_finalize_metrics_unpacks_by_key_order():
+    """Round-trip: a packed buffer built per _PACKED_KEYS is unpacked back
+    to the right scalars by _finalize_metrics (stub recipe, no devices)."""
+    dm = {"loss": 1.25, "grad_norm": 3.5, "num_label_tokens": 40.0}
+    packed = np.asarray([dm[k] for k in train_step._PACKED_KEYS],
+                        dtype=np.float32)
+    stub = types.SimpleNamespace(_check_for_nan=True)
+    pending = {"device_metrics": {"_packed": packed}, "step": 3, "lr": 1e-4,
+               "num_tokens": 64, "t_dispatch": time.perf_counter()}
+    out = train_ft.TrainFinetuneRecipeForNextTokenPrediction._finalize_metrics(
+        stub, pending)
+    assert out["loss"] == 1.25
+    assert out["grad_norm"] == 3.5
+    assert out["num_label_tokens"] == 40
+    assert out["step"] == 3

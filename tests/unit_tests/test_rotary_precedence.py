@@ -1,0 +1,66 @@
+"""Regression: yarn ``old_len`` precedence must match HF
+``_compute_yarn_parameters`` exactly (ADVICE r5) — the rope_scaling dict's
+own ``original_max_position_embeddings``, else ``max_position_embeddings``;
+a config-level original_max is consulted by longrope ONLY.
+
+Pure-numpy (no transformers import) so it stays in the tier-1 fast suite;
+full HF table parity lives in ``test_rope_scaling.py``.
+"""
+
+import numpy as np
+
+from automodel_tpu.ops.rotary import rope_parameters
+
+_YARN_NO_KEY = {"rope_type": "yarn", "factor": 4.0,
+                "beta_fast": 32.0, "beta_slow": 1.0}
+
+
+def test_yarn_ignores_config_level_original_max():
+    """A config carrying a top-level original_max + a yarn dict WITHOUT the
+    key must derive the correction range from max_position_embeddings."""
+    with_top, _ = rope_parameters(
+        64, 10000.0, dict(_YARN_NO_KEY),
+        max_position_embeddings=1024,
+        original_max_position_embeddings=256)
+    without_top, _ = rope_parameters(
+        64, 10000.0, dict(_YARN_NO_KEY),
+        max_position_embeddings=1024)
+    np.testing.assert_array_equal(with_top, without_top)
+
+    # sanity: the key IN the dict does change the table, so the equality
+    # above is not vacuous
+    in_dict, _ = rope_parameters(
+        64, 10000.0, {**_YARN_NO_KEY, "original_max_position_embeddings": 256},
+        max_position_embeddings=1024)
+    assert not np.array_equal(with_top, in_dict)
+
+
+def test_yarn_dict_key_still_wins_over_max_position():
+    explicit, _ = rope_parameters(
+        64, 10000.0, {**_YARN_NO_KEY, "original_max_position_embeddings": 512},
+        max_position_embeddings=4096)
+    baseline, _ = rope_parameters(
+        64, 10000.0, dict(_YARN_NO_KEY), max_position_embeddings=512)
+    np.testing.assert_array_equal(explicit, baseline)
+
+
+def test_longrope_keeps_config_level_original_max():
+    """longrope DOES consult the config-level original_max (HF parity): it
+    force-overrides factor with max/original and sets the short/long
+    threshold — dropping the yarn fallback must not touch this path."""
+    scaling = {"rope_type": "longrope",
+               "short_factor": [1.0] * 32, "long_factor": [4.0] * 32,
+               "factor": 2.0}
+    # seq_len beyond original_max -> long_factor path iff original_max is
+    # honored (threshold would be max_position_embeddings=8192 otherwise)
+    long_inv, long_scale = rope_parameters(
+        64, 10000.0, dict(scaling), max_position_embeddings=8192,
+        original_max_position_embeddings=4096, seq_len=6000)
+    short_inv, _ = rope_parameters(
+        64, 10000.0, dict(scaling), max_position_embeddings=8192,
+        original_max_position_embeddings=4096, seq_len=2000)
+    assert not np.array_equal(long_inv, short_inv)
+    # long path divides inv_freq by long_factor=4 (short by 1.0)
+    np.testing.assert_allclose(short_inv / long_inv, 4.0, rtol=1e-6)
+    # attention scaling derived from the overridden factor 8192/4096=2
+    assert long_scale == float(np.sqrt(1 + np.log(2) / np.log(4096)))

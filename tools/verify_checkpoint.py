@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Validate checkpoint directories against their commit manifests.
+
+Operator companion to the crash-safe checkpoint protocol
+(``automodel_tpu/checkpoint/checkpointing.py``): checks that a checkpoint
+was committed (manifest present, final name) and that every manifest-listed
+file exists with its recorded size — and, under ``--deep`` (default), that
+the checksummed host-side files still match their sha256.
+
+Usage::
+
+    python tools/verify_checkpoint.py <ckpt_dir> [<ckpt_dir> ...]
+    python tools/verify_checkpoint.py --root checkpoints/   # all committed
+    python tools/verify_checkpoint.py --root checkpoints/ --latest
+
+Exit code 0 iff every checked directory validates; 1 otherwise (so it
+slots into preflight scripts before resuming a long run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _verify_one(path: str, deep: bool) -> bool:
+    from automodel_tpu.checkpoint import checkpointing as ckpt
+
+    try:
+        manifest = ckpt.verify_manifest(path, deep=deep)
+    except ckpt.CheckpointIntegrityError as e:
+        print(f"FAIL  {path}\n      {e}")
+        return False
+    n = len(manifest.get("files", ()))
+    total = sum(e["size"] for e in manifest.get("files", ()))
+    print(f"OK    {path}  (epoch {manifest['epoch']}, step "
+          f"{manifest['step']}, {n} files, {total / 1e6:.1f} MB, "
+          f"{'deep' if deep else 'shallow'} check)")
+    return True
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Validate checkpoint dirs against their manifests.")
+    parser.add_argument("paths", nargs="*",
+                        help="checkpoint directories (epoch_E_step_S)")
+    parser.add_argument("--root", help="checkpoint root: verify every "
+                        "committed checkpoint found inside it")
+    parser.add_argument("--latest", action="store_true",
+                        help="with --root, verify only the newest committed "
+                        "checkpoint (what resume would pick)")
+    parser.add_argument("--no-deep", dest="deep", action="store_false",
+                        help="skip sha256 re-hashing (existence+size only)")
+    parser.add_argument("--adopt", action="store_true",
+                        help="write a commit manifest for pre-protocol "
+                        "(manifest-less) checkpoint dirs given as paths, "
+                        "making them resumable — asserts they are complete")
+    args = parser.parse_args(argv)
+
+    from automodel_tpu.checkpoint import checkpointing as ckpt
+
+    targets = list(args.paths)
+    if args.root:
+        if args.latest:
+            latest = ckpt.find_latest_checkpoint(args.root)
+            if latest is None:
+                print(f"FAIL  {args.root}: no committed checkpoint found")
+                return 1
+            targets.append(latest)
+        else:
+            found = [p for _, _, p in
+                     ckpt.list_committed_checkpoints(args.root)]
+            if not found:
+                print(f"FAIL  {args.root}: no committed checkpoint found")
+                return 1
+            targets.extend(found)
+            # surface uncommitted leftovers for the operator, informationally
+            for name in sorted(os.listdir(args.root)):
+                full = os.path.join(args.root, name)
+                if (os.path.isdir(full) and not ckpt.is_committed(full)
+                        and (name.endswith(ckpt.STAGING_SUFFIX)
+                             or ckpt._CKPT_RE.search(name))):
+                    print(f"note  {full}: uncommitted (interrupted save?) — "
+                          "ignored by resume, swept by retention GC")
+    if not targets:
+        parser.error("give checkpoint paths or --root")
+
+    ok = True
+    for path in targets:
+        if args.adopt:
+            try:
+                ckpt.adopt_legacy_checkpoint(path)
+            except ckpt.CheckpointIntegrityError as e:
+                print(f"FAIL  {path}\n      {e}")
+                ok = False
+                continue
+        ok &= _verify_one(path, args.deep)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
