@@ -26,6 +26,10 @@ class StatefulDataLoader:
     by sample index into the epoch permutation; iterable resumes by skipping
     consumed samples (the reference's StatefulDataLoader `.pt` behavior,
     ``recipes/base_recipe.py:158-174``).
+
+    Contract relied on by the async input pipeline (``datasets/prefetch.py``):
+    resume state advances BEFORE each yield, so ``state_dict()`` taken right
+    after ``next()`` means "resume at the batch after the one just yielded".
     """
 
     def __init__(
@@ -205,6 +209,12 @@ class StatefulDataLoader:
         self.shuffle = sd.get("shuffle", self.shuffle)
 
 
-def build_dataloader(dataset, batch_size: int = 1, **kwargs) -> StatefulDataLoader:
-    """YAML-friendly builder (``dataloader._target_``)."""
-    return StatefulDataLoader(dataset, batch_size, **kwargs)
+def build_dataloader(dataset, batch_size: int = 1, prefetch_depth: int = 0,
+                     **kwargs):
+    """YAML-friendly builder (``dataloader._target_``).  ``prefetch_depth``
+    >= 1 wraps the loader in the async input pipeline
+    (``datasets/prefetch.py``); 0 keeps the synchronous path."""
+    from automodel_tpu.datasets.prefetch import wrap_prefetch
+
+    return wrap_prefetch(StatefulDataLoader(dataset, batch_size, **kwargs),
+                         prefetch_depth)

@@ -1,0 +1,343 @@
+"""Asynchronous input pipeline: bounded background prefetch over a loader.
+
+The hot loop (``recipes/llm/train_ft.py``) dispatches the jitted step
+asynchronously, so the device keeps computing while the host returns — but
+the *input* side (dataset access, tokenize/collate, microbatch stacking)
+used to run synchronously between dispatches, charging the device
+``data_wait`` idle on every optimizer step.  :class:`PrefetchDataLoader`
+moves that host work onto a background producer thread with a bounded queue
+(``prefetch_depth`` batches of lookahead), so in steady state the consumer's
+``next()`` is a queue pop.
+
+Checkpoint correctness (the subtle part — see
+``docs/guides/input_pipeline.md``): :class:`~automodel_tpu.datasets.
+dataloader.StatefulDataLoader` advances its resume state *before* yielding,
+so with a depth-k queue the inner loader's live ``state_dict()`` runs up to
+k batches ahead of what training actually consumed — a mid-epoch checkpoint
+reading it would skip those batches on resume.  The producer therefore
+snapshots the inner state alongside every batch, and the consumer side
+distinguishes three positions:
+
+* **produced** — the inner loader's live state (k batches ahead; never
+  persisted);
+* **pending** — the snapshot of the last batch handed out by ``next()``
+  (:meth:`pending_state`), i.e. "resume AFTER that batch";
+* **committed** — the snapshot of the last batch whose optimizer step was
+  actually dispatched (:meth:`commit_state`); this is what
+  :meth:`state_dict` returns, so a checkpoint resumes at exactly the next
+  *unconsumed* batch — no skip, no replay.
+
+The recipe commits each group's snapshot when it dispatches that group
+(``train_ft.py::_run_train_optim_step``), which also makes the consumer-side
+staging double buffer safe: a batch that was pulled and staged to the device
+but never dispatched is simply not committed.
+
+Failure semantics: any exception in the producer (dataset/collate errors,
+an armed ``AUTOMODEL_FAULT=input_producer`` fault point) is forwarded
+through the queue and re-raised by the consumer's next ``next()`` — the
+training loop fails within one step instead of hanging at the queue.  On
+shutdown (epoch end, ``max_steps``, preemption, abandoned iteration) the
+producer is stopped and the inner loader is rewound to the last *yielded*
+batch, so a later fresh ``iter()`` resumes exactly where the consumer left
+off — byte-identical to the synchronous (``prefetch_depth: 0``) path.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import queue
+import threading
+from typing import Any, Iterator, Optional, Tuple
+
+from automodel_tpu.utils.fault_injection import fault_point
+
+logger = logging.getLogger(__name__)
+
+_ITEM, _END, _ERR = 0, 1, 2
+_POLL_S = 0.05
+
+
+def _state_eq(a: Optional[dict], b: Optional[dict]) -> bool:
+    """Snapshot equality tolerant of ndarray-valued loader states (plain
+    dict ``==`` raises 'truth value of an array is ambiguous' there)."""
+    try:
+        return bool(a == b)
+    except ValueError:
+        if (not isinstance(a, dict) or not isinstance(b, dict)
+                or set(a) != set(b)):
+            return False
+        import numpy as np
+
+        return all(np.array_equal(a[k], b[k]) for k in a)
+
+
+class _Producer:
+    """One background pass over the inner loader (one epoch of iteration).
+
+    The thread is a daemon and every blocking queue operation polls a stop
+    event, so neither side can deadlock the process: a stopped producer
+    drains out of a full queue, and a consumer never waits on a dead thread
+    (``get`` raises instead of hanging).
+    """
+
+    def __init__(self, loader: Any, depth: int):
+        self.loader = loader
+        self.queue: "queue.Queue" = queue.Queue(maxsize=max(int(depth), 1))
+        self.stop = threading.Event()
+        self.produced = 0
+        self.produce_s = 0.0  # host time spent producing (overlap evidence)
+        self.thread = threading.Thread(
+            target=self._run, name="automodel-input-producer", daemon=True)
+        self.thread.start()
+
+    def _put(self, item) -> bool:
+        while not self.stop.is_set():
+            try:
+                self.queue.put(item, timeout=_POLL_S)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _snapshot(self) -> Optional[dict]:
+        if hasattr(self.loader, "state_dict"):
+            return copy.deepcopy(self.loader.state_dict())
+        return None
+
+    def _run(self) -> None:
+        import time
+
+        try:
+            it = iter(self.loader)
+            while not self.stop.is_set():
+                # Armed under AUTOMODEL_FAULT=input_producer (tests): the
+                # raise below is forwarded to the consumer like any other
+                # producer-side failure.
+                fault_point("input_producer")
+                t0 = time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    # Final snapshot AFTER exhaustion: iterable loaders roll
+                    # their epoch only when the iterator finishes, so the
+                    # last batch's snapshot alone would under-report the
+                    # epoch rollover (map-style loaders roll at the last
+                    # yield, where the two snapshots coincide).
+                    self._put((_END, self._snapshot()))
+                    return
+                self.produce_s += time.perf_counter() - t0
+                self.produced += 1
+                # state advances BEFORE yield, so this reads "resume at the
+                # batch after `batch`"
+                if not self._put((_ITEM, (batch, self._snapshot()))):
+                    return
+        except BaseException as e:  # re-raised consumer-side
+            self._put((_ERR, e))
+
+    def get(self) -> Tuple[int, Any]:
+        while True:
+            try:
+                return self.queue.get(timeout=_POLL_S)
+            except queue.Empty:
+                if not self.thread.is_alive():
+                    # the producer may have put its final item and exited
+                    # between our timeout and the liveness check — drain
+                    # once more before declaring it dead
+                    try:
+                        return self.queue.get_nowait()
+                    except queue.Empty:
+                        raise RuntimeError(
+                            "input producer thread died without reporting "
+                            "— input pipeline state is unrecoverable")
+
+    def shutdown(self) -> bool:
+        """Stop and join the producer; True when the thread fully exited
+        (False = still stuck inside the dataset, e.g. a stalled fetch)."""
+        self.stop.set()
+        while True:  # unblock a producer parked on a full queue
+            try:
+                self.queue.get_nowait()
+            except queue.Empty:
+                break
+        self.thread.join(timeout=10.0)
+        return not self.thread.is_alive()
+
+
+class PrefetchDataLoader:
+    """Bounded background prefetch around a ``StatefulDataLoader``-like
+    loader, with consumed-batch checkpoint semantics (module docstring).
+
+    Drop-in for the wrapped loader everywhere the recipes use one:
+    iteration, ``len()``, ``set_epoch``, ``state_dict``/``load_state_dict``
+    and attribute access all delegate.  ``prefetch_depth`` must be >= 1 —
+    depth 0 is spelled "no wrapper" (:func:`wrap_prefetch`), keeping the
+    synchronous path byte-for-byte what it was.
+    """
+
+    def __init__(self, loader: Any, prefetch_depth: int = 2):
+        if int(prefetch_depth) < 1:
+            raise ValueError(
+                "prefetch_depth must be >= 1 for PrefetchDataLoader; use "
+                "wrap_prefetch (or the bare loader) for the synchronous "
+                "depth-0 path")
+        self.loader = loader
+        self.prefetch_depth = int(prefetch_depth)
+        self._producer: Optional[_Producer] = None
+        self._pending: Optional[dict] = None    # after last YIELDED batch
+        self._committed: Optional[dict] = None  # after last CONSUMED batch
+        # set on clean exhaustion: (last batch's snapshot, post-epoch state)
+        self._exhausted: Optional[Tuple[Optional[dict], Optional[dict]]] = None
+        # where the inner loader must be rewound to hand back produced-but-
+        # unseen batches: tracks the last yielded batch of the ACTIVE pass
+        self._rewind_target: Optional[dict] = None
+        # a producer thread that outlived its join timeout (stalled inside
+        # the dataset); no new pass may start while it is alive
+        self._zombie: Optional[threading.Thread] = None
+
+    # -- iteration ---------------------------------------------------------
+    def __iter__(self) -> Iterator[Any]:
+        # A restart while a previous pass is still live (its generator
+        # suspended somewhere) must first rewind to that pass's last yielded
+        # batch, or its queued-but-unseen lookahead would be silently
+        # skipped — close() handles both shutdown and rewind.
+        self.close()
+        if self._zombie is not None:
+            if self._zombie.is_alive():
+                # Two threads iterating one loader would race on its
+                # _index/epoch state and silently skip/duplicate batches —
+                # fail loudly instead.
+                raise RuntimeError(
+                    "a previous input producer thread is still running "
+                    "(stalled dataset read?); refusing to start a "
+                    "concurrent pass over the same loader")
+            self._zombie = None
+            self._apply_rewind()  # the rewind deferred at its shutdown
+        self._exhausted = None
+        # rewind target when nothing gets yielded this pass
+        self._rewind_target = (copy.deepcopy(self.loader.state_dict())
+                               if hasattr(self.loader, "state_dict")
+                               else None)
+        prod = _Producer(self.loader, self.prefetch_depth)
+        self._producer = prod
+        try:
+            while True:
+                kind, payload = prod.get()
+                if kind == _END:
+                    self._exhausted = (self._rewind_target, payload)
+                    if (payload is not None
+                            and self._committed is not None
+                            and _state_eq(self._committed,
+                                          self._rewind_target)):
+                        # every yielded batch was already consumed: upgrade
+                        # the committed state to the post-epoch rollover
+                        # retroactively (the last group commits BEFORE the
+                        # consumer discovers exhaustion on its next pull)
+                        self._committed = copy.deepcopy(payload)
+                    # inner already rolled past the epoch; don't unroll it
+                    self._rewind_target = payload
+                    return
+                if kind == _ERR:
+                    raise payload
+                batch, snap = payload
+                self._rewind_target = snap
+                self._pending = snap
+                yield batch
+        finally:
+            # Runs on exhaustion, error, break, max_steps, preemption and
+            # abandoned-generator GC alike.  Only the CURRENT pass owns the
+            # inner loader's position: when close() already superseded this
+            # generator (and rewound), skip.
+            if self._producer is prod:
+                self._producer = None
+                self._stop_and_rewind(prod)
+
+    def close(self) -> None:
+        """Stop any active producer and rewind the inner loader to the last
+        yielded batch (idempotent) — produced-but-unseen lookahead is handed
+        back so a later ``iter()`` replays it, like the synchronous path."""
+        prod, self._producer = self._producer, None
+        if prod is not None:
+            self._stop_and_rewind(prod)
+
+    def _stop_and_rewind(self, prod: _Producer) -> None:
+        if prod.shutdown():
+            self._apply_rewind()
+            return
+        # A zombie producer stuck inside the dataset could overwrite any
+        # rewind we apply when it finally wakes — leave the loader's live
+        # state alone (committed checkpoint state is unaffected either
+        # way), remember the thread, and defer the rewind to whoever next
+        # observes it dead (__iter__ refuses to run concurrently with it).
+        self._zombie = prod.thread
+        logger.warning(
+            "input producer thread did not stop within its join timeout; "
+            "deferring the loader rewind until it exits")
+
+    def _apply_rewind(self) -> None:
+        if (self._rewind_target is not None
+                and hasattr(self.loader, "load_state_dict")):
+            self.loader.load_state_dict(copy.deepcopy(self._rewind_target))
+
+    # -- consumed-state checkpoint contract --------------------------------
+    def pending_state(self) -> Optional[dict]:
+        """Resume snapshot of the last batch handed out by ``next()``
+        ("resume AFTER that batch").  Pass it to :meth:`commit_state` once
+        that batch's optimizer step has actually been dispatched."""
+        return self._pending
+
+    def commit_state(self, snap: Optional[dict]) -> None:
+        if snap is None:
+            return
+        fin = self._exhausted
+        if fin is not None and fin[1] is not None and _state_eq(snap, fin[0]):
+            # last batch of an exhausted pass: commit the post-epoch state
+            # (iterable loaders roll epoch/index only after the iterator
+            # finishes — see _Producer._run)
+            snap = fin[1]
+        self._committed = copy.deepcopy(snap)
+
+    def consumed_state_dict(self) -> dict:
+        """Explicit save-path alias (``BaseRecipe.save_checkpoint`` prefers
+        it): the state of the last *consumed* batch."""
+        return self.state_dict()
+
+    # -- StatefulDataLoader surface ----------------------------------------
+    def state_dict(self) -> dict:
+        if self._committed is not None:
+            return copy.deepcopy(self._committed)
+        if self._pending is not None:
+            # no commits yet (a caller driving the plain loader surface
+            # without the commit contract): resume-after-last-yielded is the
+            # sync-equivalent answer — the inner loader's LIVE state would
+            # be up to depth+1 batches ahead and skip the queued lookahead
+            return copy.deepcopy(self._pending)
+        return self.loader.state_dict()
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.close()
+        self.loader.load_state_dict(sd)
+        self._committed = copy.deepcopy(sd)
+        self._pending = None
+        self._exhausted = None
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.loader, "set_epoch"):
+            self.loader.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    def __getattr__(self, name: str) -> Any:
+        if name == "loader":  # guard: never recurse before __init__ ran
+            raise AttributeError(name)
+        return getattr(self.loader, name)
+
+
+def wrap_prefetch(loader: Any, prefetch_depth: Optional[int]) -> Any:
+    """``prefetch_depth >= 1`` -> :class:`PrefetchDataLoader`; ``0``/None ->
+    the loader unchanged (today's synchronous path)."""
+    depth = 0 if prefetch_depth is None else int(prefetch_depth)
+    if depth <= 0:
+        return loader
+    return PrefetchDataLoader(loader, depth)

@@ -97,6 +97,16 @@ class BaseRecipe:
                             retries=cfg.io_retries,
                             backoff=cfg.io_retry_backoff, desc="config.yaml")
                     else:
+                        # Async-input contract: a prefetching dataloader's
+                        # live state runs ahead of training (queued +
+                        # staged lookahead), so the save path explicitly
+                        # requests the last-CONSUMED-batch snapshot when an
+                        # object distinguishes the two (datasets/prefetch
+                        # .py) — resume then replays nothing and skips
+                        # nothing.  save_stateful pickles a plain dict
+                        # as-is.
+                        if hasattr(obj, "consumed_state_dict"):
+                            obj = obj.consumed_state_dict()
                         ckpt.save_stateful(path, key, obj, cfg)
             except Exception as e:
                 host_err = e

@@ -106,7 +106,12 @@ def build_dataloader(cfg: ConfigNode, dataset, cfg_key: str = "dataloader",
     Reference ``build_dataloader`` (``train_ft.py:226-307``): PackedSequence
     wrapping when ``packed_sequence.packed_sequence_size > 0``, collate_fn
     from YAML, batch sharding handled by the device placement (not a
-    per-rank sampler — see ``datasets/dataloader.py``)."""
+    per-rank sampler — see ``datasets/dataloader.py``).
+
+    ``<cfg_key>.prefetch_depth`` >= 1 wraps the loader in the async input
+    pipeline (``datasets/prefetch.py``): host-side tokenize/collate runs in
+    a background producer thread with that many batches of bounded
+    lookahead; 0 is the synchronous path."""
     packed_cfg = cfg.get("packed_sequence")
     if packed_cfg is not None and int(packed_cfg.get("packed_sequence_size", 0) or 0) > 0:
         dataset = PackedSequence(
@@ -124,13 +129,18 @@ def build_dataloader(cfg: ConfigNode, dataset, cfg_key: str = "dataloader",
     kwargs.setdefault("seed", seed)
     if host_rows is not None:
         kwargs.setdefault("host_rows", host_rows)
+    prefetch_depth = int(kwargs.pop("prefetch_depth", 0) or 0)
     target = dl_cfg.get("_target_") if isinstance(dl_cfg, ConfigNode) else None
     if target:
         from automodel_tpu.config.loader import resolve_target
 
         cls = resolve_target(target)
-        return cls(dataset, **kwargs)
-    return StatefulDataLoader(dataset, **kwargs)
+        loader = cls(dataset, **kwargs)
+    else:
+        loader = StatefulDataLoader(dataset, **kwargs)
+    from automodel_tpu.datasets.prefetch import wrap_prefetch
+
+    return wrap_prefetch(loader, prefetch_depth)
 
 
 def build_step_scheduler(cfg_ss: Optional[ConfigNode], dp_size: int) -> StepScheduler:
@@ -454,6 +464,13 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         if (not int(cfg.get("packed_sequence.packed_sequence_size", 0) or 0)
                 and "dataloader.pad_seq_len_divisible" not in cfg):
             cfg.set_by_dotted("dataloader.pad_seq_len_divisible", 128)
+        # Async input pipeline on by default for TRAINING input (2 batches of
+        # background lookahead + the consumer-side staging double buffer;
+        # docs/guides/input_pipeline.md).  ``dataloader.prefetch_depth: 0``
+        # restores the synchronous path; validation stays synchronous (tiny,
+        # and interleaved with the train stream).
+        if "dataloader.prefetch_depth" not in cfg:
+            cfg.set_by_dotted("dataloader.prefetch_depth", 2)
         self.dataloader = build_dataloader(
             cfg, dataset, "dataloader",
             local_batch_size=global_mb, seed=self.rng.seed,
@@ -508,6 +525,15 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         overlaps compute and the loop stays full.  The returned dict is the
         *latest finalized* metrics (step N-1 in steady state, tagged with
         its own ``step``); ``flush_metrics()`` drains the tail.
+
+        Input side: when the async loop pre-staged this group
+        (``_pull_staged`` parked it in ``self._staged_input`` — device batch
+        plus the dataloader's resume snapshot), the H2D transfer was already
+        issued while the previous step computed; the snapshot is committed
+        to the loader right after dispatch, so checkpoints persist the state
+        of the last batch actually trained on (never a staged-but-
+        undispatched lookahead).  Direct callers (bench, tests) stage inline
+        as before.
         """
         num_tokens, _ = count_tokens(batches)
         prof = self.profiling
@@ -516,8 +542,13 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         self.opt_state = set_hyperparams(
             self.opt_state, lr=self.lr_scheduler.current_lr,
             wd=self.lr_scheduler.current_wd)
-        with self.timers.record("data_staging"):
-            batch = self._device_batch(batches)
+        staged = self.__dict__.pop("_staged_input", None)
+        if staged is None:
+            with self.timers.record("data_staging"):
+                batch = self._device_batch(batches)
+            dl_state = None
+        else:
+            batch, dl_state = staged
         t0 = time.perf_counter()
         if prof.enabled and prof.barrier:
             # Measurement mode: block on this step's device results so
@@ -530,6 +561,10 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             with self.timers.record("dispatch"):
                 self.params, self.opt_state, metrics = self.step_fns.train_step(
                     self.params, self.opt_state, batch)
+        if dl_state is not None and hasattr(self.dataloader, "commit_state"):
+            # this group is now consumed: a checkpoint from here on resumes
+            # at the batch AFTER it
+            self.dataloader.commit_state(dl_state)
         pending = {
             "device_metrics": metrics,
             "step": self.step_scheduler.step,
@@ -638,18 +673,42 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         return getattr(self, "last_metrics", None)
 
     def _run_validation_epoch(self) -> Optional[float]:
+        """Token-weighted mean val loss with NO per-batch host sync: each
+        ``eval_step`` dispatch used to be followed by ``int(m[...])`` — a
+        device round trip per val batch that stalled the pipeline.  The
+        weighted sums now accumulate ON DEVICE (tiny replicated scalar adds,
+        dispatched async like the eval steps themselves) and the host
+        fetches once at epoch end."""
         if self.val_dataloader is None:
             return None
-        total_loss, total_tokens = 0.0, 0
+        import jax.numpy as jnp
+
+        total_loss = total_tokens = None
+        n_dispatched = 0
         for vb in self.val_dataloader:
             # val batches are global on every host (see _setup_data)
             batch = self._device_batch([vb], train=False,
                                        process_local=False)
             m = self.step_fns.eval_step(self.params, batch)
-            n = int(m["num_label_tokens"])
-            total_loss += float(m["loss"]) * max(n, 1)
-            total_tokens += n
-        return total_loss / max(total_tokens, 1)
+            n = m["num_label_tokens"]
+            wl = m["loss"] * jnp.maximum(n, 1.0)  # back to the batch's sum-CE
+            if total_loss is None:
+                total_loss, total_tokens = wl, n
+            else:
+                total_loss = total_loss + wl
+                total_tokens = total_tokens + n
+            n_dispatched += 1
+            if n_dispatched % 8 == 0:
+                # Backpressure, not a fetch: without any sync the host can
+                # stage the whole val set ahead of the device and every
+                # in-flight input buffer stays live in HBM at once (worst
+                # for VLM pixel_values).  Blocking on the running total
+                # bounds the pipeline at 8 staged batches.
+                jax.block_until_ready(total_loss)
+        if total_loss is None:
+            return None
+        loss, tokens = jax.device_get((total_loss, total_tokens))
+        return float(loss) / max(float(tokens), 1.0)
 
     def run_train_validation_loop(self):
         sched = self.step_scheduler
@@ -670,93 +729,187 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 "checkpoint saved" if getattr(self, "_preempt_saved", False)
                 else "checkpointing disabled, nothing saved")
 
+    def _pull_staged(self, groups):
+        """Pull the next grad-acc group and immediately issue its device
+        staging (the second half of the async input pipeline): called right
+        after step N dispatches, so batch N+1's H2D transfers overlap step
+        N's compute instead of serializing before dispatch N+1.  Returns
+        ``(batches, device_batch, dl_state)`` or None at exhaustion;
+        ``dl_state`` is the dataloader's resume snapshot for this group —
+        committed only when the group is actually dispatched, so a staged
+        lookahead abandoned by preemption/max_steps is never recorded as
+        consumed."""
+        try:
+            batches = next(groups)
+        except StopIteration:
+            return None
+        dl_state = self.dataloader.pending_state()
+        # distinct timer name: this staging runs while the previous step
+        # computes (overlapped), so it must not count toward the
+        # INPUT_TIMERS device-idle sum the way the sync path's inline
+        # "data_staging" does
+        with self.timers.record("data_staging_overlap"):
+            device_batch = self._device_batch(batches)
+        return batches, device_batch, dl_state
+
+    def _run_epoch_async(self, sched, epoch, is_main, prof, preempt):
+        """Hot loop over one epoch with double-buffered input staging.
+
+        The step-N cadence flags are captured BEFORE the lookahead pull —
+        pulling group N+1 advances ``sched.step`` — so logging/val/ckpt/
+        preemption all see the step they belong to, and a checkpoint inside
+        the body persists the state committed at dispatch N (the lookahead
+        only moved the loader's *pending* snapshot).  Returns True when a
+        preemption was handled."""
+        groups = self._timed_iter(sched)
+        try:
+            staged = self._pull_staged(groups)
+            while staged is not None:
+                batches, device_batch, dl_state = staged
+                self._staged_input = (device_batch, dl_state)
+                metrics = self._run_train_optim_step(batches)
+                step, is_val, is_ckpt = (sched.step, sched.is_val_step,
+                                         sched.is_ckpt_step)
+                # double buffer: stage batch N+1 while step N computes
+                staged = self._pull_staged(groups)
+                # The lookahead pull advanced sched.step to N+1 (the
+                # scheduler increments at yield) — but a checkpoint inside
+                # _post_step pickles the LIVE scheduler state, and saving
+                # {step: N+1} against a dataloader committed at batch N
+                # would shift every post-resume step number (and end a
+                # max_steps run one real step early).  Hold the counter at
+                # the dispatched step for the bookkeeping window; on
+                # preemption leave it there — only N steps were trained.
+                # CONTRACT for code inside this window: use the captured
+                # step/is_val/is_ckpt arguments, never read sched.step or
+                # its cadence properties directly — the generator is one
+                # group ahead of the counter until the restore below.
+                lookahead_step, sched.step = sched.step, step
+                preempted = False
+                try:
+                    preempted = self._post_step(epoch, step, is_val, is_ckpt,
+                                                metrics, is_main, prof,
+                                                preempt)
+                finally:
+                    if not preempted:
+                        sched.step = lookahead_step
+                if preempted:
+                    return True
+        finally:
+            # synchronously unwind sched -> dataloader -> producer thread
+            # (rewinds the loader to the last yielded batch)
+            groups.close()
+        return False
+
+    def _run_epoch_sync(self, sched, epoch, is_main, prof, preempt):
+        """Legacy synchronous epoch (``prefetch_depth: 0``): stage-then-
+        dispatch inside ``_run_train_optim_step``, loader state read live at
+        checkpoint time.  Returns True when a preemption was handled."""
+        for batches in self._timed_iter(sched):
+            metrics = self._run_train_optim_step(batches)
+            if self._post_step(epoch, sched.step, sched.is_val_step,
+                               sched.is_ckpt_step, metrics, is_main, prof,
+                               preempt):
+                return True
+        return False
+
+    def _post_step(self, epoch, step, is_val, is_ckpt, metrics,
+                   is_main, prof, preempt) -> bool:
+        """Per-step bookkeeping after dispatch: logging, profiling cadence,
+        validation, checkpointing, preemption poll.  ``step``/``is_val``/
+        ``is_ckpt`` are the dispatched step's values (captured by the caller
+        before any input lookahead).  Returns True when a preemption was
+        handled and the epoch loop must return."""
+        # metrics lag one step; skip steps already emitted
+        if is_main and metrics["step"] != getattr(
+                self, "_last_logged_step", -1):
+            self._last_logged_step = metrics["step"]
+            logger.info(
+                "step %d | loss %.4f | grad_norm %.3f | lr %.2e | "
+                "tps %.0f | tokens %d",
+                metrics["step"], metrics["loss"],
+                metrics["grad_norm"], metrics["lr"], metrics["tps"],
+                metrics["num_label_tokens"])
+            if self.wandb is not None:
+                self.wandb.log(metrics, step=metrics["step"])
+        if (prof.enabled and step % prof.log_interval == 0):
+            # per-step ms over the window; host-local, logged on main
+            elapsed = self.timers.get_elapsed(
+                reset=True, normalizer=prof.log_interval)
+            if is_main and elapsed:
+                logger.info(
+                    "step %d | time (ms)%s", step,
+                    "".join(f" | {n}: {v * 1e3:.2f}"
+                            for n, v in elapsed.items()))
+                if self.wandb is not None:
+                    self.wandb.log(
+                        {f"timers/{n}": v for n, v in elapsed.items()},
+                        step=step)
+        if is_val:
+            self.flush_metrics()
+            val_loss = self._run_validation_epoch()
+            if val_loss is not None and is_main:
+                logger.info("step %d | val_loss %.4f", step, val_loss)
+                if self.wandb is not None:
+                    self.wandb.log({"val_loss": val_loss}, step=step)
+        if is_ckpt and self.checkpoint_config.enabled:
+            # Drain the in-flight step first so its NaN guard runs
+            # before the params it produced are persisted.
+            self.flush_metrics()
+            self.save_checkpoint(epoch, step)
+            self._last_ckpt_step = step
+        # Preemption poll: signals_received is COLLECTIVE, so all
+        # hosts must call it on the same steps — single-process polls
+        # every step (free); multi-host every 10th (the per-step
+        # allgather would serialize async dispatch; preemption grace
+        # windows are tens of seconds, so a few steps of latency is
+        # fine) and at checkpoint boundaries.
+        poll = (jax.process_count() == 1 or step % 10 == 0 or is_ckpt)
+        if preempt is not None and poll and preempt.signals_received():
+            self.flush_metrics()
+            saved = False
+            if (self.checkpoint_config.enabled
+                    and getattr(self, "_last_ckpt_step", -1) != step):
+                # Grace-window save: if it fails (preemption kill
+                # landing mid-write, exhausted I/O retries), exit
+                # cleanly anyway — the atomic commit protocol means
+                # a failed save left only a .tmp dir and the last
+                # COMMITTED checkpoint is still what resume finds.
+                # Multi-host caveat: a host-local failure leaves the
+                # peers blocked at the commit barrier until the
+                # preemptor's hard kill — acceptable here because
+                # the whole pool is being torn down regardless; the
+                # point of the catch is the state guarantee, not
+                # saving the doomed processes.
+                try:
+                    self.save_checkpoint(epoch, step)
+                    self._last_ckpt_step = step
+                    saved = True
+                except Exception:
+                    logger.exception(
+                        "preemption checkpoint at step %d failed; "
+                        "resume will use the last committed "
+                        "checkpoint", step)
+            self._preempt_saved = (
+                saved or getattr(self, "_last_ckpt_step", -1) == step)
+            self.preempted = True
+            self._stop_trace()  # may stop inside an open window
+            return True
+        return False
+
     def _train_epochs(self, sched, is_main, prof, preempt=None):
+        # The async input path needs the loader's consumed-state contract
+        # (pending_state/commit_state — datasets/prefetch.py); a bare
+        # StatefulDataLoader (prefetch_depth: 0) takes the legacy
+        # synchronous loop unchanged.
+        async_input = hasattr(self.dataloader, "commit_state")
         for epoch in sched.epochs:
             if hasattr(self.dataloader, "set_epoch"):
                 self.dataloader.set_epoch(epoch)
-            for batches in self._timed_iter(sched):
-                metrics = self._run_train_optim_step(batches)
-                # metrics lag one step; skip steps already emitted
-                if is_main and metrics["step"] != getattr(
-                        self, "_last_logged_step", -1):
-                    self._last_logged_step = metrics["step"]
-                    logger.info(
-                        "step %d | loss %.4f | grad_norm %.3f | lr %.2e | "
-                        "tps %.0f | tokens %d",
-                        metrics["step"], metrics["loss"],
-                        metrics["grad_norm"], metrics["lr"], metrics["tps"],
-                        metrics["num_label_tokens"])
-                    if self.wandb is not None:
-                        self.wandb.log(metrics, step=metrics["step"])
-                if (prof.enabled and sched.step % prof.log_interval == 0):
-                    # per-step ms over the window; host-local, logged on main
-                    elapsed = self.timers.get_elapsed(
-                        reset=True, normalizer=prof.log_interval)
-                    if is_main and elapsed:
-                        logger.info(
-                            "step %d | time (ms)%s", sched.step,
-                            "".join(f" | {n}: {v * 1e3:.2f}"
-                                    for n, v in elapsed.items()))
-                        if self.wandb is not None:
-                            self.wandb.log(
-                                {f"timers/{n}": v for n, v in elapsed.items()},
-                                step=sched.step)
-                if sched.is_val_step:
-                    self.flush_metrics()
-                    val_loss = self._run_validation_epoch()
-                    if val_loss is not None and is_main:
-                        logger.info("step %d | val_loss %.4f",
-                                    sched.step, val_loss)
-                        if self.wandb is not None:
-                            self.wandb.log({"val_loss": val_loss},
-                                           step=sched.step)
-                if sched.is_ckpt_step and self.checkpoint_config.enabled:
-                    # Drain the in-flight step first so its NaN guard runs
-                    # before the params it produced are persisted.
-                    self.flush_metrics()
-                    self.save_checkpoint(epoch, sched.step)
-                    self._last_ckpt_step = sched.step
-                # Preemption poll: signals_received is COLLECTIVE, so all
-                # hosts must call it on the same steps — single-process polls
-                # every step (free); multi-host every 10th (the per-step
-                # allgather would serialize async dispatch; preemption grace
-                # windows are tens of seconds, so a few steps of latency is
-                # fine) and at checkpoint boundaries.
-                poll = (jax.process_count() == 1
-                        or sched.step % 10 == 0 or sched.is_ckpt_step)
-                if preempt is not None and poll \
-                        and preempt.signals_received():
-                    self.flush_metrics()
-                    saved = False
-                    if (self.checkpoint_config.enabled
-                            and getattr(self, "_last_ckpt_step", -1)
-                            != sched.step):
-                        # Grace-window save: if it fails (preemption kill
-                        # landing mid-write, exhausted I/O retries), exit
-                        # cleanly anyway — the atomic commit protocol means
-                        # a failed save left only a .tmp dir and the last
-                        # COMMITTED checkpoint is still what resume finds.
-                        # Multi-host caveat: a host-local failure leaves the
-                        # peers blocked at the commit barrier until the
-                        # preemptor's hard kill — acceptable here because
-                        # the whole pool is being torn down regardless; the
-                        # point of the catch is the state guarantee, not
-                        # saving the doomed processes.
-                        try:
-                            self.save_checkpoint(epoch, sched.step)
-                            self._last_ckpt_step = sched.step
-                            saved = True
-                        except Exception:
-                            logger.exception(
-                                "preemption checkpoint at step %d failed; "
-                                "resume will use the last committed "
-                                "checkpoint", sched.step)
-                    self._preempt_saved = (
-                        saved or getattr(self, "_last_ckpt_step", -1)
-                        == sched.step)
-                    self.preempted = True
-                    self._stop_trace()  # may stop inside an open window
-                    return
+            run_epoch = (self._run_epoch_async if async_input
+                         else self._run_epoch_sync)
+            if run_epoch(sched, epoch, is_main, prof, preempt):
+                return
             self.flush_metrics()
             # epoch-end / final checkpoint (reference is_ckpt_step's
             # last-batch clause): the generator sets its exhausted flag only

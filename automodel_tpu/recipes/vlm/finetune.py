@@ -129,15 +129,19 @@ def build_vlm_dataloader(cfg: ConfigNode, dataset, processor,
     kwargs.setdefault("seed", seed)
     if host_rows is not None:
         kwargs.setdefault("host_rows", host_rows)
+    prefetch_depth = int(kwargs.pop("prefetch_depth", 0) or 0)
     cls = StatefulDataLoader
     target = dl_cfg.get("_target_") if isinstance(dl_cfg, ConfigNode) else None
     if target:
         from automodel_tpu.config.loader import resolve_target
 
         cls = resolve_target(target)
-    return cls(dataset,
-               collate_fn=select_collate_fn(dl_cfg, processor, model=model),
-               **kwargs)
+    loader = cls(dataset,
+                 collate_fn=select_collate_fn(dl_cfg, processor, model=model),
+                 **kwargs)
+    from automodel_tpu.datasets.prefetch import wrap_prefetch
+
+    return wrap_prefetch(loader, prefetch_depth)
 
 
 class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
@@ -234,6 +238,12 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
         for key in ("dataloader", "validation_dataloader"):
             if f"{key}.pad_seq_len_divisible" not in cfg:
                 cfg.set_by_dotted(f"{key}.pad_seq_len_divisible", 128)
+        # Async input pipeline default (mirrors the LLM recipe): VLM input is
+        # the heaviest host-side pipeline in the repo — image decode/resize +
+        # processor tokenization per batch — so background prefetch buys the
+        # most here.  ``dataloader.prefetch_depth: 0`` restores sync.
+        if "dataloader.prefetch_depth" not in cfg:
+            cfg.set_by_dotted("dataloader.prefetch_depth", 2)
         self.dataloader = build_vlm_dataloader(
             cfg, dataset, self.processor, "dataloader",
             batch_size=global_mb, seed=self.rng.seed,

@@ -19,6 +19,29 @@ import jax
 import numpy as np
 
 
+# Hot-loop timers whose sum is the device idle attributable to the INPUT
+# side of the pipeline: ``data_wait`` (host time blocked pulling the next
+# grad-acc group — a queue pop under the async input pipeline, the full
+# tokenize/collate/stack cost without it) and ``data_staging`` (host time
+# issuing the batch's H2D placement on the SYNCHRONOUS path).  Overlap-aware
+# by construction: work the async pipeline moved under device compute stops
+# showing up here — the producer thread's collate time never hits these
+# timers, and the double buffer's lookahead staging is recorded separately
+# as ``data_staging_overlap`` (it runs while the previous step computes, so
+# it is not device idle).
+INPUT_TIMERS = ("data_wait", "data_staging")
+
+
+def input_idle_fraction(elapsed: Dict[str, float], window: float) -> float:
+    """Steady-state input idle: (data_wait + data_staging) as a fraction of
+    a wall-clock window — bench.py's secondary metric for the async input
+    pipeline; drop it toward 0 by raising ``dataloader.prefetch_depth``."""
+    if window <= 0:
+        return 0.0
+    idle = sum(elapsed.get(name, 0.0) for name in INPUT_TIMERS)
+    return min(idle / window, 1.0)
+
+
 @dataclasses.dataclass
 class ProfilingConfig:
     """``profiling:`` YAML section — wires :class:`Timers` into the hot loop.

@@ -122,7 +122,14 @@ class TrainStepFns:
         ``process_local``: [A, B_local, ...] arrays hold only THIS host's dp
         rows (per-host input pipeline) — assembled into global arrays via
         ``make_array_from_process_local_data`` instead of ``device_put``.
-        Replicated leaves must be host-invariant either way."""
+        Replicated leaves must be host-invariant either way.
+
+        Every placement here is an ASYNC enqueue (``device_put``/
+        ``make_array_from_process_local_data`` return before the copy
+        lands), which is what makes the recipe's double-buffered staging
+        work: issued for batch N+1 right after step N dispatches, the H2D
+        transfers overlap step N's compute instead of serializing in the
+        gap between dispatches (``train_ft.py::_pull_staged``)."""
         if self.microbatch_sharding is None:
             return stacked
         mesh = self.microbatch_sharding.mesh
@@ -352,8 +359,6 @@ def stack_microbatches(microbatches) -> Dict[str, jnp.ndarray]:
     Microbatches collated to different sequence lengths are right-padded to
     the longest using the per-key pad convention (labels -> -100 etc.).
     """
-    import numpy as np
-
     from automodel_tpu.datasets.utils import get_pad_token_from_key
 
     keys = set(microbatches[0])
@@ -364,6 +369,12 @@ def stack_microbatches(microbatches) -> Dict[str, jnp.ndarray]:
     out = {}
     for k in sorted(keys):
         arrs = [np.asarray(mb[k]) for mb in microbatches]
+        if all(a.shape == arrs[0].shape for a in arrs[1:]):
+            # fixed-shape fast path (packed sequences, pad_seq_len_divisible
+            # with one bucket, A=1): no per-key pad scan, straight to stack —
+            # this is the hot-loop common case
+            out[k] = np.stack(arrs, axis=0)
+            continue
         if k in ("pixel_values", "pixel_values_videos"):
             # Image counts vary per microbatch.  Per-row slot layout
             # [B, I, ...]: pad the slot dim I; legacy flat [B_img, ...]: pad

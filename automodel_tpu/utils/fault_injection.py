@@ -26,6 +26,13 @@ Registered checkpoint-path points (see ``BaseRecipe.save_checkpoint``):
     ckpt_pre_commit   after all state is written, before the manifest
     ckpt_pre_rename   after the manifest, before the atomic rename
     ckpt_post_commit  after the rename, before retention GC
+
+Input-pipeline points (see ``datasets/prefetch.py``):
+
+    input_producer    in the background prefetch thread, before each batch
+                      is produced — fires as a raised exception in the
+                      TRAINING loop within one step (forwarded through the
+                      queue; the consumer never hangs on a dead producer)
 """
 
 from __future__ import annotations

@@ -24,6 +24,14 @@ scale, each in its own subprocess (fresh HBM):
     with BOTH towers' FLOPs accounted.
 Secondary failures record null instead of failing the bench.  Set
 ``BENCH_MATRIX=0`` for the primary-only fast path.
+
+The primary result also carries ``input_idle_frac`` — steady-state
+``data_wait + data_staging`` as a fraction of the timed window (device idle
+attributable to the input side).  ``BENCH_PREFETCH=0`` forces the
+synchronous loader path (``BENCH_PREFETCH=k`` sets depth k), so the async
+input pipeline's with/without delta is measurable in one line:
+``BENCH_MATRIX=0 python bench.py`` vs
+``BENCH_MATRIX=0 BENCH_PREFETCH=0 python bench.py``.
 """
 
 from __future__ import annotations
@@ -101,34 +109,64 @@ SECONDARY = {
 }
 
 
+def _prefetch_overrides() -> list:
+    """``BENCH_PREFETCH=0`` disables the async input pipeline (synchronous
+    loader path) so the with/without input-idle delta is one env var away;
+    any other value sets that prefetch depth.  Unset keeps the recipe
+    default (prefetch_depth 2)."""
+    depth = os.environ.get("BENCH_PREFETCH", "")
+    if depth == "":
+        return []
+    return ["--dataloader.prefetch_depth", str(int(depth))]
+
+
 def _run_recipe(recipe_cls, yaml, overrides, steps, warmup):
     from automodel_tpu.config.arg_parser import parse_args_and_load_config
+    from automodel_tpu.training.timers import INPUT_TIMERS, input_idle_fraction
 
-    cfg = parse_args_and_load_config(["--config", yaml] + overrides)
+    cfg = parse_args_and_load_config(
+        ["--config", yaml] + _prefetch_overrides() + overrides)
     recipe = recipe_cls(cfg).setup()
 
     def stream():
         while True:
             yielded = False
-            for g in recipe.step_scheduler:
+            # _timed_iter records data_wait (host time blocked on input),
+            # which together with data_staging feeds the input-idle metric
+            for g in recipe._timed_iter(recipe.step_scheduler):
                 yielded = True
                 yield g
             if not yielded:
                 raise RuntimeError("step scheduler yielded no batches")
 
     groups = stream()
+    # drive the same input path the recipe's hot loop uses: with the async
+    # pipeline active, keep one group staged ahead (_pull_staged issues the
+    # H2D while the previous step computes) so the bench measures the
+    # shipped double-buffered loop, not a synchronous stand-in
+    use_async = hasattr(recipe.dataloader, "commit_state")
+    lookahead = {"staged": None}
 
     def one_step():
-        batches = next(groups)
+        if use_async:
+            staged = lookahead["staged"] or recipe._pull_staged(groups)
+            batches, device_batch, dl_state = staged
+            recipe._staged_input = (device_batch, dl_state)
+        else:
+            batches = next(groups)
         tokens = sum(int(np.asarray(b["input_ids"]).size) for b in batches)
         images = sum(
             int(np.prod(np.asarray(b["pixel_values"]).shape[:-3]))
             for b in batches if b.get("pixel_values") is not None)
-        return recipe._run_train_optim_step(batches), tokens, images
+        metrics = recipe._run_train_optim_step(batches)
+        if use_async:
+            lookahead["staged"] = recipe._pull_staged(groups)
+        return metrics, tokens, images
 
     for _ in range(warmup):
         one_step()
     recipe.flush_metrics()   # drain in-flight work before the timed window
+    recipe.timers.get_elapsed(reset=True)  # zero counters for steady state
 
     t0 = time.perf_counter()
     total_tokens = total_images = 0
@@ -139,7 +177,9 @@ def _run_recipe(recipe_cls, yaml, overrides, steps, warmup):
     m = recipe.flush_metrics()  # device-syncs the last dispatched step
     dt = time.perf_counter() - t0
     assert np.isfinite(m["loss"])
-    return total_tokens / dt, recipe, total_images / dt
+    idle = input_idle_fraction(
+        recipe.timers.get_elapsed(names=list(INPUT_TIMERS), reset=False), dt)
+    return total_tokens / dt, recipe, total_images / dt, idle
 
 
 def _secondary_main(name: str) -> None:
@@ -175,8 +215,8 @@ def _secondary_main(name: str) -> None:
                 "--step_scheduler.global_batch_size", "2",
                 "--step_scheduler.local_batch_size", "2",
             ]
-        tps, recipe, ips = _run_recipe(FinetuneRecipeForVLM, VLM_YAML,
-                                       overrides, steps, warmup)
+        tps, recipe, ips, _ = _run_recipe(FinetuneRecipeForVLM, VLM_YAML,
+                                          overrides, steps, warmup)
         # MFU from BOTH towers: text tokens x decoder FLOPs/token +
         # images x vision FLOPs/image (VERDICT r3 weak #6 — a tok/s with
         # the vision FLOPs unaccounted is not an MFU)
@@ -194,8 +234,8 @@ def _secondary_main(name: str) -> None:
     if SMALL:
         # shrink applies first so the secondary override wins on clashes
         overrides = SMALL_OVERRIDES + overrides
-    tps, recipe, _ = _run_recipe(TrainFinetuneRecipeForNextTokenPrediction,
-                                 YAML, overrides, steps, warmup)
+    tps, recipe, _, _ = _run_recipe(TrainFinetuneRecipeForNextTokenPrediction,
+                                    YAML, overrides, steps, warmup)
     out = {"tps": round(tps, 1)}
     if name == "long_context_16k":
         # last occurrence wins (BENCH_SMALL prepends its own packed size)
@@ -245,7 +285,7 @@ def main() -> None:
     secondary = (_collect_secondary()
                  if os.environ.get("BENCH_MATRIX", "1") != "0" else None)
 
-    tokens_per_sec, recipe, _ = _run_recipe(
+    tokens_per_sec, recipe, _, input_idle = _run_recipe(
         TrainFinetuneRecipeForNextTokenPrediction, YAML, overrides,
         steps, warmup)
     mfu = tokens_per_sec * recipe.model.flops_per_token() / PEAK_FLOPS
@@ -255,6 +295,10 @@ def main() -> None:
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 4),
+        # steady-state device idle attributable to input (data_wait +
+        # data_staging over the timed window); compare BENCH_PREFETCH=0 vs
+        # default to see the async input pipeline's contribution
+        "input_idle_frac": round(input_idle, 4),
     }
     if secondary is not None:
         result["secondary"] = secondary

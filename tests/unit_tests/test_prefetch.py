@@ -1,0 +1,239 @@
+"""Async input pipeline (``datasets/prefetch.py``): determinism across
+prefetch depths, consumed-state checkpoint semantics, producer failure
+forwarding, and clean shutdown."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from automodel_tpu.datasets.dataloader import StatefulDataLoader
+from automodel_tpu.datasets.llm.mock import build_unpacked_dataset
+from automodel_tpu.datasets.prefetch import PrefetchDataLoader, wrap_prefetch
+from automodel_tpu.utils import fault_injection as fi
+
+
+def _loader(**kw):
+    ds = build_unpacked_dataset(num_sentences=40, vocab_size=64,
+                                mean_len=12.0, seed=3)
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("seed", 11)
+    return StatefulDataLoader(ds, **kw)
+
+
+def _fingerprint(batch):
+    return tuple((k, np.asarray(batch[k]).tobytes()) for k in sorted(batch))
+
+
+def _collect(loader, epochs=1):
+    out = []
+    for _ in range(epochs):
+        out.extend(_fingerprint(b) for b in loader)
+    return out
+
+
+class _StreamingDataset:
+    """Iterable-only dataset (``is_map_style`` False in the loader)."""
+
+    streaming = True
+
+    def __init__(self, n):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield {"input_ids": [i + 2] * 6, "labels": [i + 2] * 6}
+
+
+# ---------------------------------------------------------------------------
+# determinism
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("depth", [2, 4])
+def test_prefetch_stream_matches_sync(depth):
+    """The emitted batch sequence is byte-identical for prefetch_depth in
+    {0, k} — over two epochs, so the shuffle-permutation rollover is
+    covered too."""
+    ref = _collect(_loader(), epochs=2)
+    got = _collect(wrap_prefetch(_loader(), depth), epochs=2)
+    assert got == ref
+
+
+def test_wrap_prefetch_depth_zero_is_bare_loader():
+    loader = _loader()
+    assert wrap_prefetch(loader, 0) is loader
+    assert wrap_prefetch(loader, None) is loader
+    assert isinstance(wrap_prefetch(loader, 1), PrefetchDataLoader)
+    with pytest.raises(ValueError):
+        PrefetchDataLoader(loader, 0)
+
+
+def test_delegation_surface():
+    loader = _loader()
+    w = wrap_prefetch(loader, 2)
+    assert len(w) == len(loader)
+    assert w.batch_size == 4          # __getattr__ passthrough
+    w.set_epoch(0)                    # forward-only delegate, no-op here
+
+
+# ---------------------------------------------------------------------------
+# consumed-state checkpoint semantics
+# ---------------------------------------------------------------------------
+def test_commit_resume_at_exact_next_batch():
+    """A checkpoint taken mid-epoch under prefetch resumes at exactly the
+    next unconsumed batch: no skip (the queued lookahead is not persisted),
+    no replay."""
+    ref = _collect(_loader())
+    w = wrap_prefetch(_loader(), 3)
+    it = iter(w)
+    seen = []
+    for _ in range(4):  # consume + commit four batches
+        seen.append(_fingerprint(next(it)))
+        w.commit_state(w.pending_state())
+    sd = w.state_dict()
+    it.close()  # abandon the rest (queue + iterator)
+
+    assert seen == ref[:4]
+    w2 = wrap_prefetch(_loader(), 3)
+    w2.load_state_dict(sd)
+    assert _collect(w2) == ref[4:]
+
+
+def test_uncommitted_lookahead_is_not_persisted():
+    """Batches pulled off the queue (or staged) but never committed must not
+    count as consumed — the depth-k skip bug this design exists to avoid."""
+    ref = _collect(_loader())
+    w = wrap_prefetch(_loader(), 2)
+    it = iter(w)
+    next(it)
+    w.commit_state(w.pending_state())   # batch 1 consumed
+    next(it)                            # batch 2 pulled, NEVER committed
+    sd = w.state_dict()
+    it.close()
+
+    w2 = wrap_prefetch(_loader(), 2)
+    w2.load_state_dict(sd)
+    assert next(iter(w2)) is not None
+    assert _collect(w2) == ref[2:]      # load_state_dict reset iteration
+    # resume really started at batch 2, not 3
+    w3 = wrap_prefetch(_loader(), 2)
+    w3.load_state_dict(sd)
+    assert _fingerprint(next(iter(w3))) == ref[1]
+
+
+def test_restart_while_previous_iterator_alive_skips_nothing():
+    """Starting a fresh iteration while a previous generator is still
+    referenced (not GC'd) must rewind to that pass's last yielded batch —
+    the superseded queue's lookahead is replayed, not dropped."""
+    ref = _collect(_loader())
+    w = wrap_prefetch(_loader(), 4)
+    it = iter(w)
+    got = [_fingerprint(next(it)) for _ in range(2)]
+    # `it` stays referenced; re-iterating supersedes it
+    got.extend(_collect(w))
+    assert got == ref
+    del it
+
+
+def test_state_dict_without_commits_resumes_after_last_yielded():
+    """A caller driving the plain loader surface (no commit contract) must
+    still get a safe state_dict: resume after the last YIELDED batch, not
+    the inner loader's live state (which is queued-lookahead ahead)."""
+    ref = _collect(_loader())
+    w = wrap_prefetch(_loader(), 3)
+    it = iter(w)
+    next(it)
+    next(it)
+    sd = w.state_dict()
+    it.close()
+    w2 = wrap_prefetch(_loader(), 3)
+    w2.load_state_dict(sd)
+    assert _collect(w2) == ref[2:]
+
+
+def test_abandoned_iteration_rewinds_to_last_yielded():
+    """Closing an iterator mid-epoch hands queued-but-unseen batches back:
+    a fresh iter() continues at the batch after the last yielded one,
+    exactly like the synchronous loader."""
+    ref = _collect(_loader())
+    w = wrap_prefetch(_loader(), 4)
+    it = iter(w)
+    got = [_fingerprint(next(it)) for _ in range(3)]
+    it.close()
+    assert got == ref[:3]
+    assert _collect(w) == ref[3:]
+
+
+def test_iterable_epoch_rollover_commits_rolled_state():
+    """Iterable loaders roll epoch/index only after the iterator finishes;
+    the committed state after a fully-consumed epoch must reflect that
+    rollover (matching what the synchronous path would persist)."""
+    sync = StatefulDataLoader(_StreamingDataset(12), batch_size=3,
+                              shuffle=False)
+    list(sync)
+    expected = sync.state_dict()
+    assert expected["epoch"] == 1 and expected["index"] == 0
+
+    w = wrap_prefetch(
+        StatefulDataLoader(_StreamingDataset(12), batch_size=3,
+                           shuffle=False), 2)
+    for _ in w:
+        w.commit_state(w.pending_state())
+    got = w.state_dict()
+    assert (got["epoch"], got["index"]) == (expected["epoch"],
+                                            expected["index"])
+
+
+# ---------------------------------------------------------------------------
+# failure + shutdown
+# ---------------------------------------------------------------------------
+def test_producer_exception_propagates_to_consumer():
+    class Boom(RuntimeError):
+        pass
+
+    class BadDataset:
+        streaming = True
+
+        def __iter__(self):
+            yield {"input_ids": [1, 2], "labels": [1, 2]}
+            yield {"input_ids": [3, 4], "labels": [3, 4]}
+            raise Boom("collate exploded")
+
+    w = wrap_prefetch(
+        StatefulDataLoader(BadDataset(), batch_size=1, shuffle=False), 2)
+    with pytest.raises(Boom, match="collate exploded"):
+        list(w)
+    # pipeline is reusable after the failure (fresh producer per iter)
+    with pytest.raises(Boom):
+        list(w)
+
+
+def test_producer_thread_stops_on_close():
+    w = wrap_prefetch(_loader(), 2)
+    it = iter(w)
+    next(it)
+    thread = w._producer.thread
+    assert thread.is_alive()
+    it.close()
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
+    assert w._producer is None
+    # no stray producer threads linger
+    names = [t.name for t in threading.enumerate()]
+    assert "automodel-input-producer" not in names
+
+
+@pytest.mark.fault
+def test_fault_input_producer_surfaces_within_one_step():
+    """An armed ``input_producer`` fault in the background thread must
+    surface as a raised exception at the consumer's next pull — no hang at
+    the queue."""
+    fi.reset_faults()
+    fi.configure_faults("input_producer:2")
+    try:
+        w = wrap_prefetch(_loader(), 2)
+        it = iter(w)
+        with pytest.raises(fi.InjectedFault, match="input_producer"):
+            for _ in range(10):
+                next(it)
+    finally:
+        fi.reset_faults()
