@@ -16,6 +16,7 @@ from automodel_tpu.config.loader import (
     _resolve_fn_keys,
     load_yaml_config,
     translate_value,
+    validate_config_enums,
 )
 
 
@@ -56,6 +57,8 @@ def parse_args_and_load_config(
     for dotted, value in parse_cli_overrides(rest):
         cfg.set_by_dotted(dotted, value)
     # Re-run *_fn key resolution so e.g. `--dataloader.collate_fn pkg.mod.fn`
-    # arrives as the callable, same as it would from YAML.
+    # arrives as the callable, same as it would from YAML; re-validate enum
+    # fields so a typo'd CLI override fails as early as a typo'd YAML value.
     _resolve_fn_keys(cfg)
+    validate_config_enums(cfg)
     return cfg

@@ -353,12 +353,41 @@ def _resolve_fn_keys(node: ConfigNode) -> None:
                 pass  # leave as string; consumer may handle it
 
 
+def _enum_fields():
+    """Enum-valued config fields checked at LOAD time (and re-checked after
+    CLI overrides, ``arg_parser.parse_args_and_load_config``): a typo'd value
+    must fail with the valid set listed before any mesh / train step is built
+    from it.  Allowed sets live with their owning modules (single source of
+    truth); resolved lazily to keep this module import-light."""
+    from automodel_tpu.ops.zigzag import CP_LAYOUTS
+
+    return {
+        "distributed.cp_layout": CP_LAYOUTS,
+    }
+
+
+def validate_config_enums(cfg: "ConfigNode") -> None:
+    """Raise ValueError for any registered enum field holding a value outside
+    its allowed set (None/null always passes — it means "use the default")."""
+    from automodel_tpu.ops.zigzag import normalize_cp_layout
+
+    for dotted, allowed in _enum_fields().items():
+        v = normalize_cp_layout(cfg.get(dotted, _UNSET))
+        if v is _UNSET or v is None:
+            continue
+        if v not in allowed:
+            raise ValueError(
+                f"config field {dotted!r} must be one of {list(allowed)} "
+                f"(or null for the default), got {v!r}")
+
+
 def load_yaml_config(path: str) -> ConfigNode:
     """Load a YAML file into a :class:`ConfigNode` (reference ``load_yaml``)."""
     with open(path) as f:
         data = yaml.safe_load(f) or {}
     node = ConfigNode(data)
     _resolve_fn_keys(node)
+    validate_config_enums(node)
     return node
 
 

@@ -71,6 +71,9 @@ class MeshConfig:
     cp_size: int = 1
     pp_size: int = 1          # reserved seam — only 1 is implemented
     sequence_parallel: bool = False
+    # Sequence layout over cp: "contiguous" | "zigzag" | None (None resolves
+    # to zigzag when cp_size > 1 — the causal load-balanced default).
+    cp_layout: Optional[str] = None
 
 
 class MeshManager:
@@ -95,6 +98,7 @@ class MeshManager:
         pp_size: int = 1,
         sequence_parallel: bool = False,
         expert_parallel: bool = False,
+        cp_layout: Optional[str] = None,
         devices: Optional[Sequence[jax.Device]] = None,
         allow_split_physical_axes: bool = True,
         **_unused,
@@ -108,6 +112,16 @@ class MeshManager:
         # MoE expert placement: experts sharded over the tp axis (EP) vs
         # TP inside each expert — see ``shardings.default_rules``.
         self.expert_parallel = bool(expert_parallel)
+        # Sequence layout over cp ("contiguous" | "zigzag"): resolved here so
+        # a YAML typo fails at mesh construction with the valid enum listed,
+        # not deep inside a traced attention call.
+        from automodel_tpu.ops.zigzag import (
+            normalize_cp_layout,
+            resolve_cp_layout,
+        )
+
+        self.cp_layout = resolve_cp_layout(
+            normalize_cp_layout(cp_layout), _none_to(cp_size, 1))
         devices = list(devices if devices is not None else jax.devices())
         world = len(devices)
 
@@ -209,7 +223,7 @@ def build_mesh(cfg=None, **kwargs) -> MeshManager:
     if cfg is not None:
         fields = {k: cfg.get(k) for k in (
             "dp_size", "dp_replicate_size", "tp_size", "cp_size", "pp_size",
-            "sequence_parallel"
+            "sequence_parallel", "cp_layout"
         ) if k in cfg}
         fields.update(kwargs)
         kwargs = fields

@@ -233,20 +233,29 @@ class _ShardingCtx(threading.local):
     def __init__(self):
         self.mesh: Optional[Mesh] = None
         self.rules: Optional[Rules] = None
+        self.cp_layout: str = "contiguous"
 
 
 _CTX = _ShardingCtx()
 
 
 @contextlib.contextmanager
-def sharding_context(mesh: Mesh, rules: Optional[Rules] = None):
-    """Activate activation-constraint rules for model forwards built inside."""
-    prev = (_CTX.mesh, _CTX.rules)
-    _CTX.mesh, _CTX.rules = mesh, rules if rules is not None else default_rules()
+def sharding_context(mesh: Mesh, rules: Optional[Rules] = None,
+                     cp_layout: Optional[str] = None):
+    """Activate activation-constraint rules for model forwards built inside.
+
+    ``cp_layout`` rides the context so the attention dispatcher
+    (``ops/attention.py``) can hand the ring the sequence layout the batch
+    was permuted into (``ops/zigzag.py``) without every model threading a
+    layout argument."""
+    prev = (_CTX.mesh, _CTX.rules, _CTX.cp_layout)
+    _CTX.mesh = mesh
+    _CTX.rules = rules if rules is not None else default_rules()
+    _CTX.cp_layout = cp_layout if cp_layout is not None else "contiguous"
     try:
         yield
     finally:
-        _CTX.mesh, _CTX.rules = prev
+        _CTX.mesh, _CTX.rules, _CTX.cp_layout = prev
 
 
 def current_sharding() -> Optional[Tuple[Mesh, Rules]]:
@@ -254,6 +263,14 @@ def current_sharding() -> Optional[Tuple[Mesh, Rules]]:
     if _CTX.mesh is None or _CTX.mesh.empty:
         return None
     return _CTX.mesh, _CTX.rules
+
+
+def current_cp_layout() -> str:
+    """Sequence layout of the active sharding context ("contiguous" when no
+    context is active)."""
+    if _CTX.mesh is None or _CTX.mesh.empty:
+        return "contiguous"
+    return _CTX.cp_layout
 
 
 def constrain(x: jax.Array, axes: Sequence[Optional[str]]) -> jax.Array:
@@ -277,11 +294,28 @@ class ParallelPlan:
     param_specs: Any
     param_sharding: Any
     batch_sharding: NamedSharding
+    # Sequence layout of the cp axis ("contiguous" | "zigzag"): consumed by
+    # the attention dispatcher via sharding_context and by shard_batch (the
+    # host-side permutation in ops/zigzag.py).
+    cp_layout: str = "contiguous"
 
     def shard_params(self, params: Any) -> Any:
         return jax.device_put(params, self.param_sharding)
 
     def shard_batch(self, batch: Any) -> Any:
+        # Like ``TrainStepFns.shard_batch``, this PLACES a host batch — the
+        # two are alternatives, never stages — so it applies the same
+        # zig-zag host permutation first: any caller placing batches through
+        # a cp>1 plan gets arrays whose order matches the ring's layout
+        # positions.  (Bypassing both with a raw ``jax.device_put`` under a
+        # zigzag plan is NOT supported — the ring would causally mask the
+        # wrong tokens; see docs/guides/distributed.md.)
+        if self.cp_layout == "zigzag" and isinstance(batch, dict):
+            from automodel_tpu.ops.zigzag import permute_batch_for_cp
+
+            cp = dict(self.mesh.shape).get(AXIS_CP, 1)
+            if cp > 1:
+                batch = permute_batch_for_cp(batch, cp)
         return jax.tree.map(
             lambda x: jax.device_put(x, self.batch_sharding), batch)
 
@@ -292,15 +326,24 @@ def build_parallel_plan(
     sequence_parallel: Optional[bool] = None,
     expert_parallel: Optional[bool] = None,
     rules: Optional[Rules] = None,
+    cp_layout: Optional[str] = None,
 ) -> ParallelPlan:
     """The ``FSDP2Manager.parallelize`` equivalent (``distributed/fsdp2.py:223``):
-    one call yields the full placement strategy, no model wrapping involved."""
+    one call yields the full placement strategy, no model wrapping involved.
+
+    ``cp_layout``: sequence layout over the cp axis; None inherits the
+    MeshManager's (itself defaulting to zig-zag when cp > 1 — see
+    ``ops/zigzag.py``)."""
+    from automodel_tpu.ops.zigzag import resolve_cp_layout
+
     if isinstance(mesh_manager, MeshManager):
         mesh = mesh_manager.mesh
         if sequence_parallel is None:
             sequence_parallel = mesh_manager.sequence_parallel
         if expert_parallel is None:
             expert_parallel = getattr(mesh_manager, "expert_parallel", False)
+        if cp_layout is None:
+            cp_layout = getattr(mesh_manager, "cp_layout", None)
     else:
         mesh = mesh_manager
     rules = rules if rules is not None else default_rules(
@@ -313,4 +356,5 @@ def build_parallel_plan(
         param_specs=specs,
         param_sharding=shardings,
         batch_sharding=NamedSharding(mesh, batch_spec()),
+        cp_layout=resolve_cp_layout(cp_layout, mesh.shape.get(AXIS_CP, 1)),
     )

@@ -26,6 +26,12 @@ class ForSequenceClassification:
     """Functional wrapper: ``init`` / ``__call__`` / ``param_axes`` mirror the
     backbone contract, so plans, train steps and checkpointing all compose."""
 
+    # The pooled logit reads the hidden state at the last non-pad LAYOUT
+    # index (``_last_token_index``): under the zig-zag cp sequence layout
+    # (ops/zigzag.py) that slot no longer holds the last token, so the
+    # recipes keep cp runs of this wrapper on the contiguous layout.
+    zigzag_cp_safe = False
+
     def __init__(self, backbone, num_labels: int,
                  pad_token_id: Optional[int] = None):
         self.backbone = backbone

@@ -175,28 +175,38 @@ def attention(
     * otherwise                                -> XLA SDPA (this module) —
       always correct under GSPMD, used on CPU test meshes.
     """
-    from automodel_tpu.distributed.shardings import current_sharding
+    from automodel_tpu.distributed.shardings import (
+        current_cp_layout,
+        current_sharding,
+    )
+
+    if local_window_size is not None and not causal:
+        raise NotImplementedError(
+            "local_window_size is defined for causal attention only (the "
+            "window trails the query position)")
 
     ctx = current_sharding()
     if ctx is not None:
         mesh, _rules = ctx
-        if "cp" in mesh.shape and mesh.shape["cp"] > 1 and logits_soft_cap is None:
-            # context parallelism keeps precedence over the window path:
-            # the ring's tiled inner blocks apply the window as position
-            # arithmetic, so Gemma3-style stacks stay memory-bounded at cp
-            # scale.
+        if "cp" in mesh.shape and mesh.shape["cp"] > 1:
+            # context parallelism takes UNCONDITIONAL precedence: windows
+            # and soft caps are both applied per tile inside the ring
+            # (position arithmetic / tanh before the online softmax), so no
+            # cp>1 traffic ever falls through to a path that would assume
+            # arange token order — under the zig-zag layout SDPA's built-in
+            # causal mask would be silently wrong.  The layout rides the
+            # sharding context: it must match the host-side batch
+            # permutation (ops/zigzag.py).
             from automodel_tpu.ops.ring_attention import sharded_ring_attention
 
             seg = fold_padding_into_segments(
                 q.shape[:2], segment_ids, attention_mask)
             return sharded_ring_attention(
                 q, k, v, mesh, causal=causal, segment_ids=seg, scale=scale,
-                local_window_size=local_window_size)
+                local_window_size=local_window_size,
+                logits_soft_cap=logits_soft_cap,
+                layout=current_cp_layout())
 
-    if local_window_size is not None and not causal:
-        raise NotImplementedError(
-            "local_window_size is defined for causal attention only (the "
-            "window trails the query position)")
     if local_window_size is not None and not isinstance(
             local_window_size, int):
         # TRACED window (e.g. per-layer scalar riding a scan): only SDPA
@@ -209,38 +219,52 @@ def attention(
             logits_soft_cap=logits_soft_cap,
             local_window_size=local_window_size)
 
+    # Kernel fallback chain on AVAILABILITY at every rung: splash -> flash ->
+    # SDPA.  Each rung is tried when its module imports AND its availability
+    # predicate passes — previously the flash rung was reachable only when
+    # the splash IMPORT raised, so "splash imports fine but is unavailable
+    # (shape/backend)" skipped flash entirely and dropped to XLA SDPA.
     try:
         from automodel_tpu.ops.splash_attention import (
             sharded_splash_attention,
             splash_attention_available,
             splash_attention_bshd,
         )
+    except ImportError:
+        splash_attention_available = None
 
-        if splash_attention_available(q.shape[1], k.shape[1], q.shape[3]):
-            if ctx is not None:
-                # pallas_call must run per-shard under GSPMD
-                return sharded_splash_attention(
-                    q, k, v, ctx[0], causal=causal, segment_ids=segment_ids,
-                    attention_mask=attention_mask, scale=scale,
-                    logits_soft_cap=logits_soft_cap,
-                    local_window_size=local_window_size)
-            return splash_attention_bshd(
-                q, k, v, causal=causal, segment_ids=segment_ids,
+    if (splash_attention_available is not None
+            and splash_attention_available(q.shape[1], k.shape[1],
+                                           q.shape[3])):
+        if ctx is not None:
+            # pallas_call must run per-shard under GSPMD
+            return sharded_splash_attention(
+                q, k, v, ctx[0], causal=causal, segment_ids=segment_ids,
                 attention_mask=attention_mask, scale=scale,
                 logits_soft_cap=logits_soft_cap,
                 local_window_size=local_window_size)
-    except ImportError:
-        # Older JAX without the splash kernel: plain Pallas flash attention
-        # (kv heads repeated for GQA) is the secondary TPU path.
-        from automodel_tpu.ops.flash_attention import (
-            flash_attention_available,
-            flash_attention_bshd,
-            sharded_flash_attention,
-        )
+        return splash_attention_bshd(
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            attention_mask=attention_mask, scale=scale,
+            logits_soft_cap=logits_soft_cap,
+            local_window_size=local_window_size)
 
-        if (logits_soft_cap is None and local_window_size is None
-                and flash_attention_available(
-                    q.shape[1], k.shape[1], q.shape[3])):
+    # Plain Pallas flash attention (kv heads repeated for GQA): the
+    # secondary TPU path — older JAX without splash, or shapes splash
+    # declines that flash can still take.
+    if logits_soft_cap is None and local_window_size is None:
+        try:
+            from automodel_tpu.ops.flash_attention import (
+                flash_attention_available,
+                flash_attention_bshd,
+                sharded_flash_attention,
+            )
+        except ImportError:
+            flash_attention_available = None
+
+        if (flash_attention_available is not None
+                and flash_attention_available(q.shape[1], k.shape[1],
+                                              q.shape[3])):
             if ctx is not None:
                 return sharded_flash_attention(
                     q, k, v, ctx[0], causal=causal, segment_ids=segment_ids,

@@ -129,7 +129,7 @@ def sharded_flash_attention(
     """shard_map wrapper: a pallas_call must run per-shard under GSPMD, so
     batch goes over dp and heads over tp; seq stays whole (cp=1 path — cp>1
     routes to ring attention instead)."""
-    from jax import shard_map
+    from automodel_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     qspec = P(tuple(batch_axes), None, head_axis, None)

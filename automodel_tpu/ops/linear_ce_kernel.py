@@ -45,6 +45,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from automodel_tpu.utils.jax_compat import pallas_tpu_compiler_params
+
 # Pallas interpret mode: lets the CPU test suite execute the real kernel
 # logic (tests monkeypatch this, mirroring ops/splash_attention.py).
 _INTERPRET = False
@@ -55,8 +57,12 @@ _NEG_INF = -1e30
 # Mosaic's DEFAULT scoped-vmem budget is 16 MB, far under v5e's physical
 # 128 MB — tile choices near the default ceiling failed to compile at some
 # token counts (the pipeline's own buffering isn't in our estimate).  Raising
-# the kernel limit gives the static tile table real headroom.
-_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
+# the kernel limit gives the static tile table real headroom.  The params
+# class rides the TPUCompilerParams -> CompilerParams rename shim so this
+# module (and everything importing it: loss/linear_ce.py, bench.py) loads on
+# both sides of it.
+_COMPILER_PARAMS = pallas_tpu_compiler_params(
+    vmem_limit_bytes=64 * 1024 * 1024)
 
 
 def linear_ce_kernel_available(n_tokens: int, hidden: int, vocab: int) -> bool:

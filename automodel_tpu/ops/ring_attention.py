@@ -10,11 +10,18 @@ online-softmax (running max / sum) combination.  XLA overlaps the ppermute
 with the local block's compute, so the ring rides the ICI at full duplex
 (the scaling-book recipe).
 
-Causality: query positions are globally offset by ``shard_index * S_local``;
-a kv block arriving from ring step ``t`` carries offset
-``(my_index - t) % cp * S_local``.  Blocks entirely in the future are
-skipped mathematically (their contribution multiplies to zero weight)
-without data-dependent control flow, keeping one compiled program.
+Causality & layouts: every token carries an explicit POSITION taken from the
+sequence layout (``_shard_positions``).  Under the default ``zigzag`` layout
+(``ops/zigzag.py`` — shard i holds chunks ``i`` and ``2cp-1-i``) each shard
+owns an equal mix of early and late positions, so causal work is balanced
+across the ring; ``contiguous`` keeps the naive one-run-per-shard slicing
+(shard 0 nearly idle under a causal mask, shard cp-1 doing cp blocks).
+
+Tile skipping: the inner blockwise attention computes each kv tile's
+validity from tile min/max position and segment bounds and SKIPS
+wholly-masked tiles with ``lax.cond`` — a causal ring does ~half the FLOPs
+of the mask-to-zero formulation, and with the zig-zag layout that saving is
+identical on every shard instead of concentrated on the early ones.
 """
 
 from __future__ import annotations
@@ -27,6 +34,11 @@ import jax.numpy as jnp
 from jax import lax
 
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+# Position sentinel for kv tile padding: any causal query masks it (and it
+# can never be inside a trailing window), so padded kv tails are skippable
+# by the same min-position test as real future tiles.
+_PAD_POS = jnp.iinfo(jnp.int32).max // 2
 
 
 # Tile edges for the blockwise inner attention.  Peak transient memory per
@@ -45,15 +57,41 @@ def _ceil_pad(x, mult, axis, value=0.0):
     return jnp.pad(x, widths, constant_values=value)
 
 
-def _block_attend(q, k, v, *, q_offset, causal, seg_q, seg_kv,
-                  local_window_size=None
-                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+def _shard_positions(shard_index, s_local: int, cp: int,
+                     layout: str) -> jnp.ndarray:
+    """Global token positions [s_local] held by ``shard_index`` under the
+    sequence layout.  ``shard_index`` may be traced (``lax.axis_index``)."""
+    if layout == "zigzag":
+        if s_local % 2:
+            raise ValueError(
+                f"zigzag layout needs an even local sequence length, got "
+                f"{s_local} (global seq must divide 2*cp)")
+        c = s_local // 2
+        half = jnp.arange(c, dtype=jnp.int32)
+        return jnp.concatenate([shard_index * c + half,
+                                (2 * cp - 1 - shard_index) * c + half])
+    if layout != "contiguous":
+        raise ValueError(f"unknown cp layout {layout!r}")
+    return shard_index * s_local + jnp.arange(s_local, dtype=jnp.int32)
+
+
+def _block_attend(q, k, v, *, q_positions=None, kv_positions=None, causal,
+                  seg_q, seg_kv, local_window_size=None,
+                  logits_soft_cap=None, count_tiles=False
+                  ) -> Tuple[jnp.ndarray, ...]:
     """One q-block x kv-block attention, double-chunked with online softmax
     (flash-style in XLA): returns (unnormalized out [B,Sq,Hk,G,D], row max
-    [B,Hk,G,Sq], row sumexp [B,Hk,G,Sq]) in fp32.
+    [B,Hk,G,Sq], row sumexp [B,Hk,G,Sq]) in fp32 — plus the number of kv
+    tiles actually executed when ``count_tiles`` (the skip probe).
 
-    Tile masks are computed from position/segment arithmetic on the fly —
-    no [Sq, Skv] mask or logits tensor ever materializes.
+    ``q_positions`` [Sq] / ``kv_positions`` [Skv] are explicit per-token
+    global positions (None = arange): zig-zag shards hold NON-CONTIGUOUS
+    positions, so scalar offset arithmetic cannot describe them.  Tile masks
+    are computed from position/segment arithmetic on the fly — no [Sq, Skv]
+    mask or logits tensor ever materializes — and a kv tile whose min/max
+    position and segment bounds prove it wholly masked is SKIPPED with
+    ``lax.cond`` (state passes through untouched) instead of computed and
+    zeroed.
     """
     B, Sq, Hk, G, D = q.shape
     Skv = k.shape[1]
@@ -72,68 +110,113 @@ def _block_attend(q, k, v, *, q_offset, causal, seg_q, seg_kv,
     seg_kvp = _ceil_pad(seg_kv_arr, ckv, 1, value=-2)
     use_segs = seg_q is not None
 
+    if q_positions is None:
+        q_positions = jnp.arange(Sq, dtype=jnp.int32)
+    if kv_positions is None:
+        kv_positions = jnp.arange(Skv, dtype=jnp.int32)
+    # q pads get position -1: causally masked against every real kv (and
+    # their rows are sliced off below); kv pads get the far-future sentinel
+    # so position arithmetic alone marks their tiles skippable.
+    q_pos_p = _ceil_pad(q_positions.astype(jnp.int32), cq, 0, value=-1)
+    kv_pos_p = _ceil_pad(kv_positions.astype(jnp.int32), ckv, 0,
+                         value=_PAD_POS)
+
     nq, nkv = qp.shape[1] // cq, kp.shape[1] // ckv
     qt = qp.reshape(B, nq, cq, Hk, G, D).transpose(1, 0, 2, 3, 4, 5)
     kt = kp.reshape(B, nkv, ckv, Hk, D).transpose(1, 0, 2, 3, 4)
     vt = vp.reshape(B, nkv, ckv, Hk, D).transpose(1, 0, 2, 3, 4)
     sq_t = seg_qp.reshape(B, nq, cq).transpose(1, 0, 2)
     skv_t = seg_kvp.reshape(B, nkv, ckv).transpose(1, 0, 2)
-
-    kv_pos0 = jnp.arange(nkv) * ckv
+    q_pos_t = q_pos_p.reshape(nq, cq)
+    kv_pos_t = kv_pos_p.reshape(nkv, ckv)
 
     def q_tile(carry, xs):
         del carry
-        qc, sqc, qi = xs                         # [B,cq,Hk,G,D], [B,cq], idx
-        q_pos = qi * cq + jnp.arange(cq) + q_offset      # [cq] global
+        qc, sqc, q_pos = xs                      # [B,cq,Hk,G,D],[B,cq],[cq]
+        # Tile-wide bounds for the skip test.  q pads (pos -1 / seg -1) only
+        # loosen the bounds — skipping stays SOUND (a skipped tile provably
+        # has no valid (q, kv) pair), just conservative on ragged tails.
+        q_pos_max = jnp.max(q_pos)
+        q_pos_min = jnp.min(q_pos)
+        sq_min, sq_max = jnp.min(sqc), jnp.max(sqc)
 
         @functools.partial(jax.checkpoint, prevent_cse=False)
         def kv_tile(state, xs2):
             # remat: the backward recomputes this tile's logits/probs instead
             # of saving [nq*nkv, cq, ckv] fp32 tensors (which would cost as
             # much as the un-chunked logits)
-            acc, m_run, s_run = state            # [B,cq,Hk,G,D],[B,Hk,G,cq]x2
-            kc, vc, skvc, k0 = xs2
-            logits = jnp.einsum("bqhgd,bkhd->bhgqk", qc, kc
-                                ).astype(jnp.float32)    # [B,Hk,G,cq,ckv]
-            kv_pos = k0 + jnp.arange(ckv)
-            valid = jnp.ones((B, cq, ckv), bool)
+            kc, vc, skvc, kv_pos = xs2
+
+            # --- static-structure tile skip ------------------------------
+            # A tile is provably all-masked when (any one suffices):
+            #   * causal and its EARLIEST kv position is after the LATEST
+            #     q position (wholly-future tile — the ~2x causal saving);
+            #   * sliding window and its LATEST kv position is already out
+            #     of every q's trailing window;
+            #   * its segment-id range cannot intersect the q tile's range
+            #     (also catches all-padding tiles: kv pads are -2, below
+            #     every real segment).
+            skip = jnp.min(skvc) > sq_max
+            skip |= jnp.max(skvc) < sq_min
             if causal:
-                valid &= (q_pos[:, None] >= kv_pos[None, :])[None]
+                skip |= jnp.min(kv_pos) > q_pos_max
             if local_window_size is not None:
-                valid &= (q_pos[:, None] - kv_pos[None, :]
-                          < local_window_size)[None]
-            if use_segs:
-                valid &= sqc[:, :, None] == skvc[:, None, :]
-                valid &= (skvc != 0)[:, None, :]
-            else:
-                valid &= (skvc >= 0)[:, None, :]         # pad tiles only
-            logits = jnp.where(valid[:, None, None], logits, _NEG_INF)
-            m_b = jnp.maximum(jnp.max(logits, -1), -1e30)
-            p = jnp.exp(logits - m_b[..., None])
-            p = jnp.where(valid[:, None, None], p, 0.0)
-            s_b = jnp.sum(p, -1)
-            o_b = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(vc.dtype), vc
-                             ).astype(jnp.float32)
-            m_new = jnp.maximum(m_run, m_b)
-            alpha = jnp.exp(m_run - m_new)
-            beta = jnp.exp(m_b - m_new)
-            acc = acc * alpha[..., None].transpose(0, 3, 1, 2, 4) \
-                + o_b * beta[..., None].transpose(0, 3, 1, 2, 4)
-            return (acc, m_new, s_run * alpha + s_b * beta), None
+                skip |= jnp.max(kv_pos) <= q_pos_min - local_window_size
+            # (skvc bounds span all batch rows: conservative but sound.)
+
+            def compute(state):
+                acc, m_run, s_run, n_exec = state
+                logits = jnp.einsum("bqhgd,bkhd->bhgqk", qc, kc
+                                    ).astype(jnp.float32)  # [B,Hk,G,cq,ckv]
+                if logits_soft_cap is not None:
+                    # Gemma-style cap on the (already scale-folded) logits —
+                    # applied per tile BEFORE the online softmax, so the ring
+                    # matches SDPA's cap semantics exactly.
+                    logits = logits_soft_cap * jnp.tanh(
+                        logits / logits_soft_cap)
+                valid = jnp.ones((B, cq, ckv), bool)
+                if causal:
+                    valid &= (q_pos[:, None] >= kv_pos[None, :])[None]
+                if local_window_size is not None:
+                    valid &= (q_pos[:, None] - kv_pos[None, :]
+                              < local_window_size)[None]
+                if use_segs:
+                    valid &= sqc[:, :, None] == skvc[:, None, :]
+                    valid &= (skvc != 0)[:, None, :]
+                else:
+                    valid &= (skvc >= 0)[:, None, :]     # pad tiles only
+                logits = jnp.where(valid[:, None, None], logits, _NEG_INF)
+                m_b = jnp.maximum(jnp.max(logits, -1), -1e30)
+                p = jnp.exp(logits - m_b[..., None])
+                p = jnp.where(valid[:, None, None], p, 0.0)
+                s_b = jnp.sum(p, -1)
+                o_b = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(vc.dtype), vc
+                                 ).astype(jnp.float32)
+                m_new = jnp.maximum(m_run, m_b)
+                alpha = jnp.exp(m_run - m_new)
+                beta = jnp.exp(m_b - m_new)
+                acc = acc * alpha[..., None].transpose(0, 3, 1, 2, 4) \
+                    + o_b * beta[..., None].transpose(0, 3, 1, 2, 4)
+                return (acc, m_new, s_run * alpha + s_b * beta, n_exec + 1)
+
+            return lax.cond(skip, lambda s: s, compute, state), None
 
         st0 = (jnp.zeros((B, cq, Hk, G, D), jnp.float32),
                jnp.full((B, Hk, G, cq), _NEG_INF, jnp.float32),
-               jnp.zeros((B, Hk, G, cq), jnp.float32))
-        (acc, m_run, s_run), _ = lax.scan(
-            kv_tile, st0, (kt, vt, skv_t, kv_pos0))
-        return None, (acc, m_run, s_run)
+               jnp.zeros((B, Hk, G, cq), jnp.float32),
+               jnp.int32(0))
+        (acc, m_run, s_run, n_exec), _ = lax.scan(
+            kv_tile, st0, (kt, vt, skv_t, kv_pos_t))
+        return None, (acc, m_run, s_run, n_exec)
 
-    _, (accs, ms, ss) = lax.scan(
-        q_tile, None, (qt, sq_t, jnp.arange(nq)))
+    _, (accs, ms, ss, n_execs) = lax.scan(
+        q_tile, None, (qt, sq_t, q_pos_t))
     # [nq,B,cq,...] -> [B,Sq,...]
     out = accs.transpose(1, 0, 2, 3, 4, 5).reshape(B, nq * cq, Hk, G, D)
     m = ms.transpose(1, 2, 3, 0, 4).reshape(B, Hk, G, nq * cq)
     s = ss.transpose(1, 2, 3, 0, 4).reshape(B, Hk, G, nq * cq)
+    if count_tiles:
+        return out[:, :Sq], m[..., :Sq], s[..., :Sq], jnp.sum(n_execs)
     return out[:, :Sq], m[..., :Sq], s[..., :Sq]
 
 
@@ -147,27 +230,39 @@ def ring_attention(
     segment_ids: Optional[jnp.ndarray] = None,   # [B, S_local]
     scale: Optional[float] = None,
     local_window_size: Optional[jnp.ndarray] = None,
+    logits_soft_cap: Optional[float] = None,
+    layout: str = "contiguous",
 ) -> jnp.ndarray:
     """Blockwise ring attention; call inside ``shard_map`` with the sequence
-    dim sharded over ``axis_name``.  GQA-native (no kv-head repeat)."""
+    dim sharded over ``axis_name``.  GQA-native (no kv-head repeat).
+
+    ``layout``: how global token positions map onto cp shards — must match
+    the host-side batch permutation (``ops/zigzag.py``).  Positions are
+    derived per shard from ``lax.axis_index``, so nothing extra rotates
+    around the ring.
+    """
     B, S, Hq, D = q.shape
     Hk = k.shape[2]
     G = Hq // Hk
     scale = D ** -0.5 if scale is None else scale
-    cp = lax.axis_size(axis_name)
+    from automodel_tpu.utils.jax_compat import axis_size
+
+    cp = axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
 
     qg = (q * scale).reshape(B, S, Hk, G, D)
+    q_pos = _shard_positions(my_idx, S, cp, layout)
 
     def attend_and_combine(state, k_t, v_t, seg_t, t):
         acc, m_run, s_run = state
+        # the kv block arriving at ring step t left shard (my_idx - t) % cp
         kv_idx = (my_idx - t) % cp
-        # global positions expressed as a query offset relative to the
-        # arriving kv block (blocks entirely in the future mask to zero)
+        kv_pos = _shard_positions(kv_idx, S, cp, layout)
         out_b, m_b, s_b = _block_attend(
-            qg, k_t, v_t, q_offset=(my_idx - kv_idx) * S, causal=causal,
-            seg_q=segment_ids, seg_kv=seg_t,
-            local_window_size=local_window_size)
+            qg, k_t, v_t, q_positions=q_pos, kv_positions=kv_pos,
+            causal=causal, seg_q=segment_ids, seg_kv=seg_t,
+            local_window_size=local_window_size,
+            logits_soft_cap=logits_soft_cap)
         m_new = jnp.maximum(m_run, m_b)
         alpha = jnp.exp(m_run - m_new)                  # rescale old acc
         beta = jnp.exp(m_b - m_new)
@@ -213,13 +308,17 @@ def sharded_ring_attention(
     segment_ids=None,
     scale=None,
     local_window_size=None,
+    logits_soft_cap=None,
+    layout: str = "contiguous",
     batch_axes=("dp_replicate", "dp_shard"),
     seq_axis: str = "cp",
     head_axis: str = "tp",
 ):
     """shard_map wrapper: [B, S, H, D] global arrays with S sharded over cp,
-    heads over tp, batch over dp -> ring attention per shard."""
-    from jax import shard_map
+    heads over tp, batch over dp -> ring attention per shard.  The caller is
+    responsible for the arrays already being in ``layout`` order along S
+    (the recipes permute batches host-side; see ``ops/zigzag.py``)."""
+    from automodel_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     qspec = P(tuple(batch_axes), seq_axis, head_axis, None)
@@ -227,7 +326,8 @@ def sharded_ring_attention(
 
     fn = functools.partial(
         ring_attention, axis_name=seq_axis, causal=causal, scale=scale,
-        local_window_size=local_window_size)
+        local_window_size=local_window_size,
+        logits_soft_cap=logits_soft_cap, layout=layout)
 
     if segment_ids is None:
         def wrapped(q, k, v):

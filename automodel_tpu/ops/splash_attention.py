@@ -194,7 +194,7 @@ def sharded_splash_attention(
     """shard_map wrapper: a pallas_call runs per-shard under GSPMD — batch
     over dp, heads over tp, sequence whole (cp>1 routes to ring attention
     before reaching here)."""
-    from jax import shard_map
+    from automodel_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from automodel_tpu.ops.attention import fold_padding_into_segments

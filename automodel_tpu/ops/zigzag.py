@@ -1,0 +1,174 @@
+"""Zig-zag context-parallel sequence layout: causal load balancing for the
+ring (``ops/ring_attention.py``).
+
+Under the CONTIGUOUS layout each cp shard holds one run of ``S/cp``
+consecutive tokens.  Causal attention then gives shard 0 one block of real
+work (its own kv) and shard ``cp-1`` all ``cp`` blocks — the ring is gated
+on the slowest shard and the early shards idle through masked blocks.  The
+ZIG-ZAG layout (Striped Attention, Brandon et al. 2023; Llama-3's
+round-robin CP load balancer) splits the sequence into ``2*cp`` chunks and
+gives shard ``i`` chunks ``i`` and ``2*cp-1-i``:
+
+    cp=2, chunks 0..3:   shard 0 = [0, 3]     shard 1 = [1, 2]
+    cp=4, chunks 0..7:   shard 0 = [0, 7]     shard 1 = [1, 6]
+                         shard 2 = [2, 5]     shard 3 = [3, 4]
+
+Every shard owns an equal mix of early and late positions, so under a causal
+mask every (q shard, kv shard) pair carries the same ~half-masked workload
+and the tile-skipping ring does only the FLOPs the mask requires — evenly.
+
+The permutation is applied ONCE, host-side, to every sequence-dim batch key
+(tokens, labels, segment ids, padding masks, position ids) before device
+placement (``training/train_step.py::TrainStepFns.shard_batch``).  Training
+never needs the inverse: the loss is a per-token sum, invariant under any
+consistent permutation of tokens and labels.  True token positions ride an
+explicit ``position_ids`` key (injected here when absent) so rotary
+embeddings stay exact; the ring derives its causal-mask positions from the
+layout itself (``ring_attention._shard_positions``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+CP_LAYOUTS = ("contiguous", "zigzag")
+
+# Batch keys carrying a trailing sequence dim that must ride the permutation.
+# ``position_ids`` is handled separately (its seq dim is not trailing in the
+# M-RoPE [..., S, 3] form).
+_SEQ_KEYS = ("input_ids", "labels", "segment_ids", "attention_mask",
+             "loss_mask")
+# Keys with NO text-sequence dim: pass through untouched.  Any key outside
+# both sets whose trailing dim happens to equal S raises — an unlisted
+# per-token key silently left unpermuted would train on misaligned features.
+_PASSTHROUGH_KEYS = frozenset({
+    "position_ids",  # handled explicitly (M-RoPE axis differs)
+    "pixel_values", "pixel_values_videos",
+    "image_grid_thw", "video_grid_thw",
+    "input_audio_embeds", "audio_embed_sizes", "audio_attention_mask",
+    "dropout_rng",
+})
+
+
+def normalize_cp_layout(layout: Optional[str]) -> Optional[str]:
+    """Map the YAML null spellings ("none"/"null"/"") to None — the single
+    place that knows them; mesh/recipes/loader all reuse this."""
+    if isinstance(layout, str) and layout.lower() in ("none", "null", ""):
+        return None
+    return layout
+
+
+def validate_cp_layout(layout: Optional[str]) -> Optional[str]:
+    """None (defer to the cp-size default) or a member of CP_LAYOUTS."""
+    if layout is None:
+        return None
+    if layout not in CP_LAYOUTS:
+        raise ValueError(
+            f"distributed.cp_layout must be one of {list(CP_LAYOUTS)}, "
+            f"got {layout!r}")
+    return layout
+
+
+def resolve_cp_layout(layout: Optional[str], cp_size: int) -> str:
+    """Default policy: zig-zag whenever the ring is real (cp > 1)."""
+    validate_cp_layout(layout)
+    if layout is not None:
+        return layout
+    return "zigzag" if cp_size > 1 else "contiguous"
+
+
+def zigzag_indices(seq_len: int, cp: int) -> np.ndarray:
+    """Gather indices (layout order -> original position): element ``j`` of
+    the permuted sequence is original token ``zigzag_indices(S, cp)[j]``.
+
+    Shard-major: the first ``S/cp`` entries are shard 0's tokens (chunk 0
+    then chunk ``2cp-1``), and slicing the permuted array into cp equal runs
+    — exactly what the ``P(..., 'cp')`` batch sharding does — hands each
+    shard its zig-zag pair.
+    """
+    if seq_len % (2 * cp):
+        raise ValueError(
+            f"zigzag cp layout needs seq_len divisible by 2*cp="
+            f"{2 * cp}, got {seq_len} (pad the batch — "
+            "dataloader.pad_seq_len_divisible — or use cp_layout: contiguous)")
+    chunks = np.arange(seq_len, dtype=np.int64).reshape(2 * cp, -1)
+    order = np.stack([np.arange(cp), 2 * cp - 1 - np.arange(cp)], 1).ravel()
+    return chunks[order].ravel()
+
+
+def zigzag_inverse_indices(seq_len: int, cp: int) -> np.ndarray:
+    """Scatter inverse: ``permuted[inverse] == original`` order."""
+    return np.argsort(zigzag_indices(seq_len, cp))
+
+
+def zigzag_permute(x, cp: int, axis: int = -1):
+    """Reorder ``axis`` (length S) into the zig-zag layout.  Works on numpy
+    and jax arrays (pure take)."""
+    idx = zigzag_indices(x.shape[axis], cp)
+    return np.take(x, idx, axis=axis) if isinstance(x, np.ndarray) \
+        else x.take(idx, axis=axis)
+
+
+def zigzag_unpermute(x, cp: int, axis: int = -1):
+    """Inverse of :func:`zigzag_permute` (debug/inspection only — training
+    never needs it; see the module docstring)."""
+    idx = zigzag_inverse_indices(x.shape[axis], cp)
+    return np.take(x, idx, axis=axis) if isinstance(x, np.ndarray) \
+        else x.take(idx, axis=axis)
+
+
+def permute_batch_for_cp(stacked: Dict[str, np.ndarray], cp: int,
+                         inject_position_ids: bool = True,
+                         ) -> Dict[str, np.ndarray]:
+    """Host-side zig-zag reorder of one stacked microbatch dict.
+
+    * token-aligned keys (``_SEQ_KEYS``) whose trailing dim equals S are
+      permuted along that dim;
+    * ``position_ids`` is permuted along its S axis (trailing for [A, B, S],
+      axis -2 for M-RoPE [A, B, S, 3]) — or INJECTED as the permutation
+      itself when absent, so rotary tables see true token positions instead
+      of the model's arange default;
+    * everything else (pixel_values, grid metadata, audio frames, scalar
+      labels) has no text-sequence dim and passes through untouched.
+
+    Called once per optimizer step on numpy arrays before device staging —
+    a [A, B, S] int take, noise next to tokenize/collate.
+    """
+    ids = stacked.get("input_ids")
+    if ids is None:
+        return stacked
+    seq_len = ids.shape[-1]
+    idx = zigzag_indices(seq_len, cp)
+    out = dict(stacked)
+    for key, v in stacked.items():
+        if key in _PASSTHROUGH_KEYS or getattr(v, "ndim", 0) < ids.ndim:
+            # lower-rank keys (e.g. sequence-classification labels [A, B])
+            # carry no per-token dim even when a size coincides with S
+            continue
+        if v.shape[-1] != seq_len:
+            continue
+        if key not in _SEQ_KEYS:
+            raise ValueError(
+                f"batch key {key!r} (shape {tuple(v.shape)}) has a trailing "
+                f"dim of the sequence length {seq_len} but is not registered "
+                "for the zig-zag cp permutation — leaving it unpermuted "
+                "would silently misalign per-token data.  Add it to "
+                "ops/zigzag.py _SEQ_KEYS (permute) or _PASSTHROUGH_KEYS "
+                "(no text-sequence dim), or use cp_layout: contiguous.")
+        out[key] = np.take(np.asarray(v), idx, axis=-1)
+    pos = out.get("position_ids")
+    if pos is not None:
+        axis = -2 if np.asarray(pos).ndim >= 2 and pos.shape[-1] != seq_len \
+            else -1
+        if pos.shape[axis] != seq_len:
+            raise ValueError(
+                f"position_ids shape {pos.shape} has no axis of the "
+                f"sequence length {seq_len}; cannot apply the zig-zag "
+                "cp layout")
+        out["position_ids"] = np.take(np.asarray(pos), idx, axis=axis)
+    elif inject_position_ids:
+        out["position_ids"] = np.broadcast_to(
+            idx.astype(np.int32), ids.shape).copy()
+    return out

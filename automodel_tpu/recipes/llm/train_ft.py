@@ -199,6 +199,15 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
     # recipe clips at 1.0 by default (``vlm/finetune.py:641``).
     _default_max_grad_norm: Optional[float] = None
 
+    # Whether this recipe's batches tolerate the zig-zag cp sequence layout
+    # (ops/zigzag.py).  Plain token streams do: the loss is a per-token sum,
+    # invariant under a consistent permutation, and true positions ride
+    # ``position_ids``.  The VLM recipe overrides this to False — its models
+    # scatter image/audio features into placeholder tokens by SEQUENCE-SCAN
+    # order (models/vlm.py::merge_image_embeds cumsum), which a permuted
+    # stream would scramble.
+    _zigzag_cp_safe: bool = True
+
     def __init__(self, cfg: ConfigNode):
         super().__init__()
         self.cfg = cfg
@@ -234,8 +243,10 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             kwargs = dist_cfg.to_dict() if dist_cfg is not None else {}
             self.mesh_manager = MeshManager(**kwargs)
 
-        # Model + plan
+        # Model + plan (cp layout policy needs the model: families can opt
+        # out of the zig-zag permutation via ``zigzag_cp_safe = False``)
         self.model = build_model(cfg.get("model"))
+        self._apply_cp_layout_policy()
         self.plan = build_parallel_plan(self.model, self.mesh_manager)
         self.param_sharding = self.plan.param_sharding
 
@@ -416,6 +427,43 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 "step_scheduler.max_steps or lr_scheduler.lr_decay_steps")
             return 1000
         return max(steps_per_epoch * max(sched.num_epochs, 1), 1)
+
+    def _apply_cp_layout_policy(self):
+        """Resolve the cp sequence layout before any plan is built.
+
+        The MeshManager defaults to zig-zag when cp > 1 (causal load
+        balancing, ``ops/zigzag.py``); recipes whose batches are NOT
+        permutation-safe (``_zigzag_cp_safe``) drop that default back to
+        contiguous unless the YAML asked for zig-zag explicitly.  Every
+        plan/train-step built afterwards inherits the decision, and
+        ``shard_batch`` applies the matching host-side batch reorder."""
+        cp = getattr(self.mesh_manager, "cp_size", 1)
+        layout = getattr(self.mesh_manager, "cp_layout", "contiguous")
+        if cp <= 1:
+            return
+        from automodel_tpu.ops.zigzag import normalize_cp_layout
+
+        # Null spellings mean "use the default" (same normalization as
+        # MeshManager) — only a real layout name is an explicit user choice
+        # that overrides the safety fallback below.
+        explicit = normalize_cp_layout(
+            self.cfg.get("distributed.cp_layout")) is not None
+        safe = (self._zigzag_cp_safe
+                and getattr(self.model, "zigzag_cp_safe", True))
+        if layout == "zigzag" and not safe and not explicit:
+            logger.warning(
+                "cp=%d: dropping the default zig-zag sequence layout back "
+                "to contiguous — %s/%s consumes the token stream by "
+                "sequence-scan order (modality-feature merge or last-token "
+                "pooling), which a permuted stream would scramble (set "
+                "distributed.cp_layout: zigzag to force it anyway)",
+                cp, type(self).__name__, type(self.model).__name__)
+            self.mesh_manager.cp_layout = layout = "contiguous"
+        if self.dist_info.is_main:
+            logger.info("context parallelism: cp=%d, sequence layout %r%s",
+                        cp, layout,
+                        " (causal load-balanced ring, masked kv tiles "
+                        "skipped)" if layout == "zigzag" else "")
 
     # -- overridable setup hooks (the VLM recipe swaps these) ---------------
     def _build_freeze_mask(self):

@@ -152,6 +152,15 @@ class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
     # YAML ``max_grad_norm: null`` disables.
     _default_max_grad_norm = 1.0
 
+    # VLM models scatter image/audio features into placeholder tokens by
+    # sequence-scan order (``models/vlm.py::merge_image_embeds`` cumsum;
+    # Phi-4-MM audio analogue), so the zig-zag cp layout's host-side token
+    # permutation would mis-assign patches: keep the contiguous layout
+    # unless the YAML forces zigzag (text-only data through this recipe).
+    # See docs/guides/distributed.md "Context parallelism & sequence
+    # layouts".
+    _zigzag_cp_safe = False
+
     def _device_batch(self, batches, train: bool = True,
                       process_local=None):
         """Host-side grid validation before device placement: a batch whose

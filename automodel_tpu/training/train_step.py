@@ -108,6 +108,11 @@ class TrainStepFns:
     init_opt_state: Callable
     opt_state_sharding: Any
     microbatch_sharding: Any
+    # Sequence layout over the cp axis: "zigzag" makes shard_batch apply the
+    # host-side zig-zag reorder (ops/zigzag.py) before placement, matching
+    # the position vectors the ring derives per shard.
+    cp_layout: str = "contiguous"
+    cp_size: int = 1
 
     def shard_batch(self, stacked: Dict[str, Any],
                     process_local: bool = False) -> Dict[str, Any]:
@@ -118,6 +123,13 @@ class TrainStepFns:
         context-parallelize); legacy flat [A, B_img, H, W, C] image stacks
         shard when the dp split divides, else replicate; anything else is
         replicated.
+
+        When the plan's ``cp_layout`` is zig-zag, the batch is first
+        REORDERED on the host (tokens/labels/segment ids/masks permuted
+        along S, true positions injected as ``position_ids``) — once per
+        step, before the async H2D staging, so the device only ever sees
+        layout-ordered arrays.  The inverse is never needed: training loss
+        is invariant under a consistent token/label permutation.
 
         ``process_local``: [A, B_local, ...] arrays hold only THIS host's dp
         rows (per-host input pipeline) — assembled into global arrays via
@@ -132,6 +144,10 @@ class TrainStepFns:
         gap between dispatches (``train_ft.py::_pull_staged``)."""
         if self.microbatch_sharding is None:
             return stacked
+        if self.cp_layout == "zigzag" and self.cp_size > 1:
+            from automodel_tpu.ops.zigzag import permute_batch_for_cp
+
+            stacked = permute_batch_for_cp(stacked, self.cp_size)
         mesh = self.microbatch_sharding.mesh
         spec = self.microbatch_sharding.spec  # P(None, dp_axes, cp_axes)
         rep = NamedSharding(mesh, P())
@@ -232,9 +248,13 @@ def build_train_step(
             "itself; configure the loss with reduction='sum' (got "
             f"{loss_fn.reduction!r}) or it would be normalized twice.")
     # Activation sharding constraints (TP/SP plan) are read from this context
-    # at trace time; identity when no plan is given.
+    # at trace time; identity when no plan is given.  The plan's cp layout
+    # rides along so the attention dispatcher picks the matching ring
+    # position scheme.
     if plan is not None:
-        ctx = functools.partial(sharding_context, plan.mesh, plan.rules)
+        ctx = functools.partial(sharding_context, plan.mesh, plan.rules,
+                                cp_layout=getattr(plan, "cp_layout",
+                                                  "contiguous"))
     else:
         ctx = contextlib.nullcontext
 
@@ -340,7 +360,10 @@ def build_train_step(
         )
         init_opt_jit = jax.jit(init_opt, out_shardings=opt_sharding)
         return TrainStepFns(train_jit, eval_jit, init_opt_jit,
-                            opt_sharding, mb_sharding)
+                            opt_sharding, mb_sharding,
+                            cp_layout=getattr(plan, "cp_layout",
+                                              "contiguous"),
+                            cp_size=int(dict(mesh.shape).get("cp", 1)))
 
     return TrainStepFns(
         jax.jit(train_step, donate_argnums=(0, 1)),

@@ -1,0 +1,40 @@
+"""Version shims for JAX APIs that moved/renamed across the releases this
+framework spans (same role as the ``pltpu.CompilerParams`` shim in
+``ops/linear_ce_kernel.py``)."""
+
+from __future__ import annotations
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """Construct Pallas TPU compiler params across the
+    ``TPUCompilerParams`` -> ``CompilerParams`` rename."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis inside ``shard_map``:
+    ``lax.axis_size`` where it exists, else ``lax.psum(1, axis)`` (which
+    constant-folds to a python int on the older releases)."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` (new name, ``check_vma=``) with fallback to
+    ``jax.experimental.shard_map.shard_map`` (old home, ``check_rep=``)."""
+    try:
+        from jax import shard_map as _sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_vma=check_vma)

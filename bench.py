@@ -61,6 +61,10 @@ SMALL_OVERRIDES = [
     "--model.config.num_key_value_heads", "4",
     "--model.config.head_dim", "32",
     "--model.config.vocab_size", "2048",
+    # the dataset must shrink WITH the model: the YAML's mock tokenizer
+    # emits ids up to its own vocab_size (8192), and out-of-vocab labels
+    # NaN the loss against the 2048-vocab small model
+    "--dataset.vocab_size", "2048",
     "--dataset.num_sentences", "64",
     "--dataset.mean_len", "96",
     "--dataset.max_sentence_len", "127",
@@ -106,6 +110,16 @@ SECONDARY = {
         "--step_scheduler.local_batch_size", "1",
         "--dataset.num_sentences", "2048",
     ],
+    # long-context CONTEXT-PARALLEL leg: handled by _cp_secondary_main (the
+    # multichip dryrun path — dp2xcp2xtp2 over virtual devices, since one
+    # chip cannot host a ring); the [] is a placeholder so _collect_secondary
+    # schedules it.  Reports zigzag tok/s, with _vs_baseline = zigzag tok/s /
+    # contiguous tok/s (the causal load-balancing + tile-skip win).
+    # ``BENCH_CP_LAYOUT=zigzag|contiguous`` pins one layout (no ratio);
+    # ``BENCH_CP_TOKENS`` sets the global tokens per row — default 4096
+    # (2048 under BENCH_SMALL), sized for the virtual-CPU mesh; use 16384
+    # on a real slice for the leg's nominal long-context shape.
+    "long_context_16k_cp": [],
 }
 
 
@@ -182,8 +196,88 @@ def _run_recipe(recipe_cls, yaml, overrides, steps, warmup):
     return total_tokens / dt, recipe, total_images / dt, idle
 
 
+def _cp_secondary_main() -> None:
+    """Child process: the context-parallel long-context leg on the multichip
+    dryrun mesh (dp2 x cp2 x tp2 over 8 virtual CPU devices — the same path
+    MULTICHIP_r*.json exercises; one physical chip cannot host a ring).
+
+    Times the REAL jitted train step (ring attention + fused CE + optimizer)
+    through ``TrainStepFns.shard_batch`` — so the zig-zag leg pays its
+    host-side permutation too — on the tiny flagship model at
+    ``BENCH_CP_TOKENS`` tokens per row (default 4096, 2048 under
+    BENCH_SMALL).  Absolute tok/s on virtual CPU devices is not
+    chip-meaningful; the zigzag/contiguous RATIO is the metric (reported as
+    the leg's vs_baseline).
+    """
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import __graft_entry__ as graft
+    from automodel_tpu.distributed.mesh import MeshManager
+    from automodel_tpu.distributed.shardings import build_parallel_plan
+    from automodel_tpu.loss.linear_ce import FusedLinearCrossEntropy
+    from automodel_tpu.loss.masked_ce import IGNORE_INDEX
+    from automodel_tpu.optim import build_optimizer
+    from automodel_tpu.training.train_step import build_train_step
+
+    # Default row length is sized for the virtual-CPU mesh this leg always
+    # runs on (8 host devices share one CPU, so the quadratic attention cost
+    # is paid nearly serially): 4096 finishes inside the secondary timeout.
+    # On a real multichip slice set BENCH_CP_TOKENS=16384 for the leg's
+    # nominal long-context shape.
+    tokens = int(os.environ.get("BENCH_CP_TOKENS", "2048" if SMALL
+                                else "4096"))
+    steps, warmup = (2, 1) if SMALL else (3, 1)
+    model = graft._flagship(tiny=True)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 255, (1, 2, tokens))     # [A=1, B=2 (dp2), S]
+    labels = np.roll(ids, -1, -1)
+    labels[..., -1] = IGNORE_INDEX
+    stacked = {"input_ids": ids.astype(np.int32),
+               "labels": labels.astype(np.int32)}
+
+    def run(layout: str) -> float:
+        mm = MeshManager(dp_size=2, cp_size=2, tp_size=2,
+                         sequence_parallel=True, cp_layout=layout)
+        plan = build_parallel_plan(model, mm)
+        fns = build_train_step(
+            model, build_optimizer(name="adamw", lr=1e-3),
+            loss_fn=FusedLinearCrossEntropy(chunk_len=512), plan=plan)
+        params = plan.shard_params(model.init(jax.random.key(0)))
+        opt_state = fns.init_opt_state(params)
+
+        def one_step(params, opt_state):
+            batch = fns.shard_batch(dict(stacked))  # incl. host permutation
+            return fns.train_step(params, opt_state, batch)
+
+        for _ in range(warmup):
+            params, opt_state, m = one_step(params, opt_state)
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, m = one_step(params, opt_state)
+        jax.block_until_ready(m["loss"])
+        assert np.isfinite(float(m["loss"]))
+        return steps * ids.size / (time.perf_counter() - t0)
+
+    pinned = os.environ.get("BENCH_CP_LAYOUT", "")
+    if pinned:
+        print(json.dumps({"tps": round(run(pinned), 1)}))
+        return
+    contig = run("contiguous")
+    zig = run("zigzag")
+    print(json.dumps({"tps": round(zig, 1),
+                      "vs_baseline": round(zig / contig, 4)}))
+
+
 def _secondary_main(name: str) -> None:
     """Child process: one secondary config, prints {"tps": ...}."""
+    if name == "long_context_16k_cp":
+        return _cp_secondary_main()
     steps, warmup = (4, 2) if SMALL else (8, 3)
     if name == "unpacked" and not SMALL:
         # two length buckets (1024/1152) after the 128-alignment: warm both

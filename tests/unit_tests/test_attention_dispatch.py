@@ -1,0 +1,107 @@
+"""Attention dispatcher fallback chain: splash -> flash -> SDPA on
+AVAILABILITY at every rung (not only on ImportError), and cp routing with
+the sequence layout from the sharding context."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.ops import attention as attn_mod
+from automodel_tpu.ops import flash_attention as flash_mod
+from automodel_tpu.ops import splash_attention as splash_mod
+
+
+def _qkv(B=1, S=128, Hq=4, Hk=2, D=16):
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    return (jax.random.normal(kq, (B, S, Hq, D), jnp.float32),
+            jax.random.normal(kk, (B, S, Hk, D), jnp.float32),
+            jax.random.normal(kv, (B, S, Hk, D), jnp.float32))
+
+
+def test_flash_reachable_when_splash_imports_but_unavailable(monkeypatch):
+    """The satellite bug: splash importing fine but reporting unavailable
+    must fall to the FLASH rung, not skip straight to SDPA."""
+    calls = []
+    monkeypatch.setattr(splash_mod, "splash_attention_available",
+                        lambda *a: False)
+    monkeypatch.setattr(flash_mod, "flash_attention_available",
+                        lambda *a: True)
+    monkeypatch.setattr(
+        flash_mod, "flash_attention_bshd",
+        lambda q, k, v, **kw: calls.append("flash") or jnp.zeros_like(q))
+    q, k, v = _qkv()
+    attn_mod.attention(q, k, v, causal=True)
+    assert calls == ["flash"]
+
+
+def test_splash_takes_precedence_when_available(monkeypatch):
+    calls = []
+    monkeypatch.setattr(splash_mod, "splash_attention_available",
+                        lambda *a: True)
+    monkeypatch.setattr(
+        splash_mod, "splash_attention_bshd",
+        lambda q, k, v, **kw: calls.append("splash") or jnp.zeros_like(q))
+    monkeypatch.setattr(flash_mod, "flash_attention_available",
+                        lambda *a: True)
+    q, k, v = _qkv()
+    attn_mod.attention(q, k, v, causal=True)
+    assert calls == ["splash"]
+
+
+def test_sdpa_anchor_when_no_kernel_available(monkeypatch):
+    """Both kernel rungs unavailable (the CPU test reality): XLA SDPA
+    answers, and numerically agrees with calling it directly."""
+    monkeypatch.setattr(splash_mod, "splash_attention_available",
+                        lambda *a: False)
+    monkeypatch.setattr(flash_mod, "flash_attention_available",
+                        lambda *a: False)
+    q, k, v = _qkv()
+    out = attn_mod.attention(q, k, v, causal=True)
+    ref = attn_mod.dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+
+def test_flash_rung_skipped_for_soft_cap(monkeypatch):
+    """Soft-cap traffic must not land on the flash rung (unsupported there):
+    with splash unavailable it goes to SDPA."""
+    calls = []
+    monkeypatch.setattr(splash_mod, "splash_attention_available",
+                        lambda *a: False)
+    monkeypatch.setattr(flash_mod, "flash_attention_available",
+                        lambda *a: calls.append("flash-probed") or True)
+    q, k, v = _qkv()
+    out = attn_mod.attention(q, k, v, causal=True, logits_soft_cap=30.0)
+    ref = attn_mod.dot_product_attention(q, k, v, causal=True,
+                                         logits_soft_cap=30.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+    assert calls == []          # the flash rung was never even probed
+
+
+@pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+def test_cp_routes_to_ring_with_context_layout(monkeypatch, layout):
+    """cp > 1 in the sharding context routes to the ring and hands it the
+    context's sequence layout."""
+    from automodel_tpu.distributed.mesh import MeshManager
+    from automodel_tpu.distributed.shardings import sharding_context
+    from automodel_tpu.ops import ring_attention as ring_mod
+
+    seen = {}
+
+    def fake_ring(q, k, v, mesh, **kw):
+        seen.update(kw)
+        return jnp.zeros_like(q)
+
+    monkeypatch.setattr(ring_mod, "sharded_ring_attention", fake_ring)
+    mm = MeshManager(dp_size=4, cp_size=2, tp_size=1, cp_layout=layout)
+    q, k, v = _qkv()
+    with sharding_context(mm.mesh, cp_layout=mm.cp_layout):
+        attn_mod.attention(q, k, v, causal=True)
+    assert seen.get("layout") == layout
+    # soft-cap traffic must ALSO stay on the ring under cp (SDPA's arange
+    # causal mask would be silently wrong on a zig-zag-permuted stream)
+    seen.clear()
+    with sharding_context(mm.mesh, cp_layout=mm.cp_layout):
+        attn_mod.attention(q, k, v, causal=True, logits_soft_cap=30.0)
+    assert seen.get("layout") == layout
+    assert seen.get("logits_soft_cap") == 30.0

@@ -96,7 +96,7 @@ def test_tiled_inner_blocks_multi_tile(monkeypatch):
 
     def tiled(q, k, v):
         qg = q.reshape(B, S, Hk, Hq // Hk, D) * (D ** -0.5)
-        out, m, s = ra._block_attend(qg, k, v, q_offset=0, causal=True,
+        out, m, s = ra._block_attend(qg, k, v, causal=True,
                                      seg_q=seg, seg_kv=seg)
         return (out / jnp.maximum(s, 1e-30)[..., None].transpose(
             0, 3, 1, 2, 4)).reshape(B, S, Hq, D)

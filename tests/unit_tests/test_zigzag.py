@@ -1,0 +1,387 @@
+"""Zig-zag context-parallel layout: permutation round-trips, ring-vs-SDPA
+equivalence on a CPU mesh, the tile-skip probe, config-load validation, and
+contiguous-vs-zigzag train-step parity.
+
+Deliberately NOT slow-marked: this is the tier-1 guard for the causal
+load-balanced cp path (shapes are tiny; the mesh is the virtual 8-device CPU
+mesh from conftest)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.distributed.mesh import MeshManager
+from automodel_tpu.ops import ring_attention as ra
+from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.ring_attention import sharded_ring_attention
+from automodel_tpu.ops.zigzag import (
+    permute_batch_for_cp,
+    resolve_cp_layout,
+    zigzag_indices,
+    zigzag_inverse_indices,
+    zigzag_permute,
+    zigzag_unpermute,
+)
+
+
+def _rand_qkv(key, B=8, S=32, Hq=4, Hk=2, D=16):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, S, Hq, D), jnp.float32)
+    k = jax.random.normal(kk, (B, S, Hk, D), jnp.float32)
+    v = jax.random.normal(kv, (B, S, Hk, D), jnp.float32)
+    return q, k, v
+
+
+# ---------------------------------------------------------------------------
+# Permutation structure
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("cp", [2, 4])
+def test_zigzag_indices_shard_structure(cp):
+    """Shard i of the shard-major layout holds chunks i and 2cp-1-i, and the
+    host-side indices agree with the ring's per-shard position vectors."""
+    S = 32
+    idx = zigzag_indices(S, cp)
+    per_shard = idx.reshape(cp, S // cp)
+    chunk = S // (2 * cp)
+    for i in range(cp):
+        expect = np.concatenate([
+            np.arange(i * chunk, (i + 1) * chunk),
+            np.arange((2 * cp - 1 - i) * chunk, (2 * cp - i) * chunk)])
+        np.testing.assert_array_equal(per_shard[i], expect)
+        np.testing.assert_array_equal(
+            np.asarray(ra._shard_positions(i, S // cp, cp, "zigzag")),
+            expect)
+    # contiguous agreement too
+    np.testing.assert_array_equal(
+        np.asarray(ra._shard_positions(1, S // cp, cp, "contiguous")),
+        np.arange(S // cp) + S // cp)
+
+
+@pytest.mark.parametrize("cp", [2, 4])
+def test_permutation_round_trip(cp):
+    S = 48
+    x = np.random.default_rng(0).integers(0, 100, (3, 2, S))
+    np.testing.assert_array_equal(zigzag_unpermute(zigzag_permute(x, cp), cp),
+                                  x)
+    idx, inv = zigzag_indices(S, cp), zigzag_inverse_indices(S, cp)
+    np.testing.assert_array_equal(idx[inv], np.arange(S))
+    np.testing.assert_array_equal(inv[idx], np.arange(S))
+
+
+def test_zigzag_needs_divisible_seq():
+    with pytest.raises(ValueError, match="divisible by 2\\*cp"):
+        zigzag_indices(30, 4)
+
+
+def test_permute_batch_all_keys_round_trip():
+    """Every batch key round-trips, including M-RoPE [A, B, S, 3] position
+    ids; keys without a text-sequence dim pass through untouched."""
+    cp, A, B, S = 2, 2, 3, 16
+    rng = np.random.default_rng(1)
+    batch = {
+        "input_ids": rng.integers(0, 50, (A, B, S)),
+        "labels": rng.integers(-100, 50, (A, B, S)),
+        "segment_ids": rng.integers(0, 3, (A, B, S)),
+        "attention_mask": rng.integers(0, 2, (A, B, S)),
+        "position_ids": rng.integers(0, S, (A, B, S, 3)),   # M-RoPE
+        "pixel_values": rng.normal(size=(A, B, 2, 4, 4, 3)),
+        "image_grid_thw": rng.integers(1, 3, (A, 4, 3)),
+    }
+    out = permute_batch_for_cp(dict(batch), cp)
+    inv = zigzag_inverse_indices(S, cp)
+    for key in ("input_ids", "labels", "segment_ids", "attention_mask"):
+        np.testing.assert_array_equal(np.take(out[key], inv, axis=-1),
+                                      batch[key])
+    np.testing.assert_array_equal(np.take(out["position_ids"], inv, axis=-2),
+                                  batch["position_ids"])
+    for key in ("pixel_values", "image_grid_thw"):
+        np.testing.assert_array_equal(out[key], batch[key])
+
+
+def test_permute_batch_injects_true_positions():
+    """Without explicit position ids, the permutation itself is injected so
+    rotary tables see original token positions."""
+    cp, A, B, S = 2, 1, 2, 16
+    batch = {"input_ids": np.arange(A * B * S).reshape(A, B, S),
+             "labels": np.zeros((A, B, S), np.int64)}
+    out = permute_batch_for_cp(batch, cp)
+    idx = zigzag_indices(S, cp)
+    assert out["position_ids"].shape == (A, B, S)
+    np.testing.assert_array_equal(out["position_ids"][0, 0], idx)
+    # sequence-classification labels [A, B] have no seq dim: untouched
+    out2 = permute_batch_for_cp(
+        {"input_ids": batch["input_ids"], "labels": np.arange(B)[None]}, cp)
+    np.testing.assert_array_equal(out2["labels"], np.arange(B)[None])
+
+
+# ---------------------------------------------------------------------------
+# Ring-vs-SDPA equivalence under the zig-zag layout (CPU mesh)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("cp", [2, 4])
+def test_ring_zigzag_matches_sdpa_packed_gqa(cp, monkeypatch):
+    """GQA + packed segment ids + padding tail, soft-cap-free: permute
+    host-side, ring with zig-zag positions, un-permute, compare to the
+    unpermuted SDPA reference.  Tiny tile edges force real multi-tile
+    scans (and therefore real skips) inside every ring step."""
+    monkeypatch.setattr(ra, "_CQ", 8)
+    monkeypatch.setattr(ra, "_CKV", 8)
+    mm = MeshManager(dp_size=8 // cp, cp_size=cp, tp_size=1)
+    assert mm.cp_layout == "zigzag"          # the cp>1 default
+    q, k, v = _rand_qkv(jax.random.key(0))
+    seg = np.ones((8, 32), np.int32)
+    seg[:, 12:20] = 2
+    seg[:, 28:] = 0                          # padding tail
+    seg = jnp.asarray(seg)
+    ref = dot_product_attention(q, k, v, causal=True, segment_ids=seg)
+
+    qp, kp, vp = (zigzag_permute(x, cp, axis=1) for x in (q, k, v))
+    out = sharded_ring_attention(
+        qp, kp, vp, mm.mesh, causal=True,
+        segment_ids=zigzag_permute(seg, cp, axis=1), layout="zigzag")
+    out = zigzag_unpermute(out, cp, axis=1)
+    keep = np.asarray(seg) != 0              # pad rows are unconstrained
+    np.testing.assert_allclose(np.asarray(out)[keep], np.asarray(ref)[keep],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_zigzag_sliding_window(monkeypatch):
+    monkeypatch.setattr(ra, "_CQ", 8)
+    monkeypatch.setattr(ra, "_CKV", 8)
+    cp = 4
+    mm = MeshManager(dp_size=2, cp_size=cp, tp_size=1)
+    q, k, v = _rand_qkv(jax.random.key(1))
+    out = sharded_ring_attention(
+        zigzag_permute(q, cp, 1), zigzag_permute(k, cp, 1),
+        zigzag_permute(v, cp, 1), mm.mesh, causal=True,
+        local_window_size=jnp.int32(6), layout="zigzag")
+    ref = dot_product_attention(q, k, v, causal=True, local_window_size=6)
+    np.testing.assert_allclose(np.asarray(zigzag_unpermute(out, cp, 1)),
+                               np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_zigzag_soft_cap_matches_sdpa(monkeypatch):
+    """Gemma-style logits soft cap through the zig-zag ring: the cp branch
+    must never fall through to SDPA (whose causal mask assumes arange order
+    — silently wrong on a permuted stream), so the ring caps per tile."""
+    monkeypatch.setattr(ra, "_CQ", 8)
+    monkeypatch.setattr(ra, "_CKV", 8)
+    cp = 2
+    mm = MeshManager(dp_size=4, cp_size=cp, tp_size=1)
+    q, k, v = _rand_qkv(jax.random.key(4))
+    out = sharded_ring_attention(
+        zigzag_permute(q, cp, 1), zigzag_permute(k, cp, 1),
+        zigzag_permute(v, cp, 1), mm.mesh, causal=True,
+        logits_soft_cap=10.0, layout="zigzag")
+    ref = dot_product_attention(q, k, v, causal=True, logits_soft_cap=10.0)
+    np.testing.assert_allclose(np.asarray(zigzag_unpermute(out, cp, 1)),
+                               np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_zigzag_grads_match(monkeypatch):
+    monkeypatch.setattr(ra, "_CQ", 8)
+    monkeypatch.setattr(ra, "_CKV", 8)
+    cp = 2
+    mm = MeshManager(dp_size=4, cp_size=cp, tp_size=1)
+    q, k, v = _rand_qkv(jax.random.key(2))
+
+    def loss_ring(q, k, v):
+        o = sharded_ring_attention(
+            zigzag_permute(q, cp, 1), zigzag_permute(k, cp, 1),
+            zigzag_permute(v, cp, 1), mm.mesh, causal=True, layout="zigzag")
+        return jnp.sum(o ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    g1 = jax.grad(loss_ring)(q, k, v)
+    g2 = jax.grad(loss_ref)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=5e-4, atol=5e-4)
+
+
+# ---------------------------------------------------------------------------
+# Tile-skip probe: wholly-masked kv tiles are NOT executed
+# ---------------------------------------------------------------------------
+def _expected_tiles(q_pos, kv_pos, tile, causal=True, window=None):
+    """Brute-force count of kv tiles with >= 1 maskable-valid (q, kv) pair."""
+    n = 0
+    for i in range(0, len(q_pos), tile):
+        for j in range(0, len(kv_pos), tile):
+            qs, ks = q_pos[i:i + tile], kv_pos[j:j + tile]
+            valid = np.ones((len(qs), len(ks)), bool)
+            if causal:
+                valid &= qs[:, None] >= ks[None, :]
+            if window is not None:
+                valid &= qs[:, None] - ks[None, :] < window
+            n += bool(valid.any())
+    return n
+
+
+def _count_tiles(q_pos, kv_pos, **kw):
+    B, Sq, Hk, G, D = 1, len(q_pos), 1, 1, 8
+    keys = jax.random.split(jax.random.key(3), 3)
+    qg = jax.random.normal(keys[0], (B, Sq, Hk, G, D))
+    k = jax.random.normal(keys[1], (B, len(kv_pos), Hk, D))
+    v = jax.random.normal(keys[2], (B, len(kv_pos), Hk, D))
+    *_, n = ra._block_attend(
+        qg, k, v, q_positions=jnp.asarray(q_pos),
+        kv_positions=jnp.asarray(kv_pos), seg_q=None, seg_kv=None,
+        count_tiles=True, **kw)
+    return int(n)
+
+
+def test_tile_skip_future_block_fully_skipped(monkeypatch):
+    """Contiguous layout, shard 0 queries vs shard 1's kv block: every tile
+    is in the future — zero executed (this was the pay-and-zero case)."""
+    monkeypatch.setattr(ra, "_CQ", 8)
+    monkeypatch.setattr(ra, "_CKV", 8)
+    q_pos = np.arange(16)
+    kv_pos = np.arange(16, 32)
+    assert _count_tiles(q_pos, kv_pos, causal=True) == 0
+    # and the mirror block (all past) executes everything
+    assert _count_tiles(kv_pos, q_pos, causal=True) == 4
+
+
+@pytest.mark.parametrize("cp", [2, 4])
+def test_tile_skip_zigzag_cross_shard(cp, monkeypatch):
+    """Zig-zag shards: the executed-tile count equals the brute-force count
+    of tiles with any causally-valid pair — wholly-future tiles (each
+    shard's late chunk vs later positions) are skipped, not zeroed."""
+    monkeypatch.setattr(ra, "_CQ", 8)
+    monkeypatch.setattr(ra, "_CKV", 8)
+    S = 32 * cp
+    idx = zigzag_indices(S, cp).reshape(cp, S // cp)
+    skipped_somewhere = False
+    for qi in range(cp):
+        for ki in range(cp):
+            got = _count_tiles(idx[qi], idx[ki], causal=True)
+            want = _expected_tiles(idx[qi], idx[ki], 8)
+            assert got == want
+            total = (len(idx[qi]) // 8) * (len(idx[ki]) // 8)
+            skipped_somewhere |= got < total
+    assert skipped_somewhere
+
+
+def test_tile_skip_sliding_window(monkeypatch):
+    """Off-window tiles (too far in the past) skip as well."""
+    monkeypatch.setattr(ra, "_CQ", 8)
+    monkeypatch.setattr(ra, "_CKV", 8)
+    q_pos = np.arange(96, 128)               # late queries
+    kv_pos = np.arange(0, 32)                # early kv, far outside window
+    got = _count_tiles(q_pos, kv_pos, causal=True,
+                       local_window_size=jnp.int32(8))
+    assert got == 0
+    got = _count_tiles(q_pos, q_pos, causal=True,
+                       local_window_size=jnp.int32(8))
+    assert got == _expected_tiles(q_pos, q_pos, 8, window=8) < 16
+
+
+def test_zigzag_balances_executed_tiles(monkeypatch):
+    """The load-balance claim itself: per-shard executed-tile totals over a
+    full causal ring are equal under zig-zag, maximally skewed under
+    contiguous."""
+    monkeypatch.setattr(ra, "_CQ", 8)
+    monkeypatch.setattr(ra, "_CKV", 8)
+    cp, S = 4, 128
+    zig = zigzag_indices(S, cp).reshape(cp, S // cp)
+    contig = np.arange(S).reshape(cp, S // cp)
+    for layout, per_shard in (("zigzag", zig), ("contiguous", contig)):
+        totals = [sum(_expected_tiles(per_shard[i], per_shard[j], 8)
+                      for j in range(cp)) for i in range(cp)]
+        if layout == "zigzag":
+            assert len(set(totals)) == 1, totals
+        else:
+            assert max(totals) >= 2 * min(totals), totals
+
+
+# ---------------------------------------------------------------------------
+# Config / plan plumbing
+# ---------------------------------------------------------------------------
+def test_cp_layout_validates_at_mesh_build():
+    with pytest.raises(ValueError, match="contiguous.*zigzag"):
+        MeshManager(dp_size=4, cp_size=2, cp_layout="banana")
+    assert MeshManager(dp_size=4, cp_size=2).cp_layout == "zigzag"
+    assert MeshManager(dp_size=8, cp_size=1).cp_layout == "contiguous"
+    assert MeshManager(dp_size=4, cp_size=2,
+                       cp_layout="contiguous").cp_layout == "contiguous"
+
+
+def test_cp_layout_validates_at_config_load(tmp_path):
+    """The tier-1 guard: a typo'd distributed.cp_layout fails at config-load
+    time (YAML and CLI override alike), not deep inside a traced step."""
+    from automodel_tpu.config.arg_parser import parse_args_and_load_config
+    from automodel_tpu.config.loader import load_yaml_config
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("distributed:\n  cp_size: 2\n  cp_layout: zigzig\n")
+    with pytest.raises(ValueError, match="cp_layout"):
+        load_yaml_config(str(bad))
+
+    good = tmp_path / "good.yaml"
+    good.write_text("distributed:\n  cp_size: 2\n  cp_layout: zigzag\n")
+    cfg = load_yaml_config(str(good))
+    assert cfg.get("distributed.cp_layout") == "zigzag"
+    with pytest.raises(ValueError, match="cp_layout"):
+        parse_args_and_load_config(
+            ["--config", str(good), "--distributed.cp_layout", "banana"])
+    cfg = parse_args_and_load_config(
+        ["--config", str(good), "--distributed.cp_layout", "contiguous"])
+    assert cfg.get("distributed.cp_layout") == "contiguous"
+
+
+def test_resolve_cp_layout_default():
+    assert resolve_cp_layout(None, 1) == "contiguous"
+    assert resolve_cp_layout(None, 2) == "zigzag"
+    assert resolve_cp_layout("contiguous", 4) == "contiguous"
+    with pytest.raises(ValueError):
+        resolve_cp_layout("diagonal", 2)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: full train step, contiguous vs zig-zag (the dryrun invariant)
+# ---------------------------------------------------------------------------
+def test_train_step_parity_contiguous_vs_zigzag():
+    """One jitted optimizer step on a dp2 x cp2 x tp2 mesh: loss and
+    grad_norm must agree across layouts (same tokens, same math, different
+    shard order) — fp32 model, so tolerances are tight."""
+    from automodel_tpu.distributed.shardings import build_parallel_plan
+    from automodel_tpu.loss.masked_ce import IGNORE_INDEX
+    from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from automodel_tpu.optim import build_optimizer
+    from automodel_tpu.training.train_step import build_train_step
+
+    model = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, tie_word_embeddings=True))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 127, (1, 4, 32))
+    labels = np.roll(ids, -1, -1)
+    labels[..., -1] = IGNORE_INDEX
+    stacked = {"input_ids": ids.astype(np.int32),
+               "labels": labels.astype(np.int32)}
+
+    results = {}
+    for layout in ("contiguous", "zigzag"):
+        mm = MeshManager(dp_size=2, cp_size=2, tp_size=2,
+                         sequence_parallel=True, cp_layout=layout)
+        plan = build_parallel_plan(model, mm)
+        assert plan.cp_layout == layout
+        fns = build_train_step(
+            model, build_optimizer(name="adamw", lr=1e-3), plan=plan)
+        params = plan.shard_params(model.init(jax.random.key(0)))
+        opt_state = fns.init_opt_state(params)
+        batch = fns.shard_batch(dict(stacked))
+        if layout == "zigzag":
+            assert "position_ids" in batch        # injected true positions
+        _, _, metrics = fns.train_step(params, opt_state, batch)
+        results[layout] = (float(metrics["loss"]),
+                           float(metrics["grad_norm"]))
+
+    (l0, g0), (l1, g1) = results["contiguous"], results["zigzag"]
+    assert np.isfinite(l0) and np.isfinite(l1)
+    np.testing.assert_allclose(l1, l0, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(g1, g0, rtol=1e-3, atol=1e-3)
