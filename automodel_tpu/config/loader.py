@@ -359,21 +359,35 @@ def _enum_fields():
     must fail with the valid set listed before any mesh / train step is built
     from it.  Allowed sets live with their owning modules (single source of
     truth); resolved lazily to keep this module import-light."""
+    from automodel_tpu.ops.moe import MOE_DISPATCHES
     from automodel_tpu.ops.zigzag import CP_LAYOUTS
 
     return {
         "distributed.cp_layout": CP_LAYOUTS,
+        "moe.dispatch": MOE_DISPATCHES,
     }
+
+
+def normalize_null_spelling(v: Any) -> Any:
+    """YAML null spellings ("none"/"null"/"") mean "use the default" for
+    every enum-like config field.  THE single home of that rule —
+    ``ops/zigzag.normalize_cp_layout`` and ``ops/moe.normalize_moe_dispatch``
+    delegate here, so a new spelling cannot desynchronize config-load
+    validation from model-config validation."""
+    if isinstance(v, str) and v.lower() in ("none", "null", ""):
+        return None
+    return v
 
 
 def validate_config_enums(cfg: "ConfigNode") -> None:
     """Raise ValueError for any registered enum field holding a value outside
     its allowed set (None/null always passes — it means "use the default")."""
-    from automodel_tpu.ops.zigzag import normalize_cp_layout
-
     for dotted, allowed in _enum_fields().items():
-        v = normalize_cp_layout(cfg.get(dotted, _UNSET))
-        if v is _UNSET or v is None:
+        v = cfg.get(dotted, _UNSET)
+        if v is _UNSET:
+            continue
+        v = normalize_null_spelling(v)
+        if v is None:
             continue
         if v not in allowed:
             raise ValueError(

@@ -87,7 +87,12 @@ def default_rules(sequence_parallel: bool = False,
         "act_seq_nosp": (AXIS_CP,),
         "act_embed": None,
         "act_vocab": (AXIS_TP,),
-        # MoE merged-token dim: all batch-ish axes (routing is per-token)
+        # MoE merged-token dim: all batch-ish axes (routing is per-token).
+        # Both expert dispatch paths ride this rule — the onehot path's
+        # grouped [G, ...] tensors and the sorted path's expert-sorted
+        # [T*k(+pad), ...] buffers (ops/moe.py::sorted_expert_ffn), whose
+        # FFN intermediate additionally carries "expert_mlp" so non-EP
+        # meshes shard it over tp.
         "act_tokens": (AXIS_DP_REPLICATE, AXIS_DP_SHARD, AXIS_CP),
     }
     return rules

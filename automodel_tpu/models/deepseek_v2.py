@@ -7,7 +7,9 @@ the pair permutation cancels inside the attention inner products), with
 the V2 gate instead of the V3 aux-free router: SOFTMAX scores, ``greedy``
 (V2-Lite) or ``group_limited_greedy`` (per-group MAX) top-k, combine
 weights = selected scores x routed_scaling_factor with no renorm and no
-``e_score_correction_bias`` parameter.
+``e_score_correction_bias`` parameter.  Expert compute (incl. the
+``moe_dispatch`` sorted/onehot knob) is inherited from the V3 family
+unchanged — the gate is the only seam.
 """
 
 from __future__ import annotations

@@ -26,8 +26,10 @@ TPU shape:
   (``HfSpec.layer_offset``);
 * routing is the DeepSeek sigmoid + aux-free bias correction +
   group-limited top-k (``ops/moe.noaux_topk_routing``), feeding the same
-  static-shape dispatch/combine expert core as Mixtral/Qwen3-MoE, plus the
-  dense ``shared_experts`` branch.
+  routing-agnostic expert core as Mixtral/Qwen3-MoE (``ops/moe.expert_ffn``:
+  sort-based grouped matmuls by default, one-hot dispatch/combine as the
+  ``moe_dispatch: onehot`` oracle), plus the dense ``shared_experts``
+  branch.
 
 ``e_score_correction_bias`` is carried as a parameter for checkpoint
 round-trip but has NO gradient path (selection-only, matching HF's
@@ -58,8 +60,10 @@ from automodel_tpu.distributed.shardings import constrain
 from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from automodel_tpu.ops.attention import attention
 from automodel_tpu.ops.moe import (
-    expert_dispatch_ffn,
+    expert_ffn,
     group_and_capacity,
+    group_tokens,
+    mask_padded_tokens,
     noaux_topk_routing,
 )
 from automodel_tpu.ops.norms import rms_norm
@@ -90,6 +94,8 @@ class DeepseekV3Config(LlamaConfig):
     # dispatch capacity knobs (framework-side, see ops/moe.py)
     moe_capacity_factor: Optional[float] = 2.0
     moe_group_size: int = 512
+    # Expert dispatch path ("sorted" | "onehot"; None = the sorted default).
+    moe_dispatch: Optional[str] = None
 
     def __post_init__(self):
         # HF DeepseekV3Config defines head_dim = qk_rope_head_dim (the rope
@@ -99,6 +105,13 @@ class DeepseekV3Config(LlamaConfig):
             self.head_dim = self.qk_rope_head_dim
         super().__post_init__()
         self.model_type = "deepseek_v3"
+        from automodel_tpu.ops.moe import (
+            normalize_moe_dispatch,
+            validate_moe_dispatch,
+        )
+
+        self.moe_dispatch = validate_moe_dispatch(
+            normalize_moe_dispatch(self.moe_dispatch))
         if not 0 <= self.first_k_dense_replace <= self.num_hidden_layers:
             raise ValueError(
                 f"first_k_dense_replace={self.first_k_dense_replace} out of "
@@ -407,15 +420,20 @@ class DeepseekV3ForCausalLM(LlamaForCausalLM):
         T = B * S
         M, C = group_and_capacity(T, cfg.moe_group_size, E, k,
                                   cfg.moe_capacity_factor)
-        G = T // M
-        xg = constrain(x.reshape(G, M, H), ("act_tokens", None, None))
+        xg, pad = group_tokens(x.reshape(T, H), M)
+        xg = constrain(xg, ("act_tokens", None, None))
         weights, idx = self._route(xg, p["gate"], k)
-        routed = expert_dispatch_ffn(
+        weights, idx, _ = mask_padded_tokens(weights, idx, pad, E)
+        routed = expert_ffn(
             xg, weights, idx,
             p["experts"]["gate_proj"]["kernel"],
             p["experts"]["up_proj"]["kernel"],
             p["experts"]["down_proj"]["kernel"],
-            capacity=C, compute_dtype=self.compute_dtype)
+            capacity=C, dispatch=cfg.moe_dispatch,
+            compute_dtype=self.compute_dtype)
+        routed = routed.reshape(-1, H)
+        if pad:
+            routed = routed[:T]
         return routed.reshape(B, S, H) + self._dense_mlp(x, p["shared_experts"])
 
     def forward_embeds(

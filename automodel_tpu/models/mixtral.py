@@ -38,10 +38,20 @@ class MixtralConfig(LlamaConfig):
     # lossless (capacity = group size, exact HF parity); see ops/moe.py.
     moe_capacity_factor: Optional[float] = 2.0
     moe_group_size: int = 512
+    # Expert dispatch path ("sorted" | "onehot"; None = the sorted default).
+    # Recipes thread the top-level ``moe.dispatch`` YAML knob here.
+    moe_dispatch: Optional[str] = None
 
     def __post_init__(self):
         super().__post_init__()
         self.model_type = "mixtral"
+        from automodel_tpu.ops.moe import (
+            normalize_moe_dispatch,
+            validate_moe_dispatch,
+        )
+
+        self.moe_dispatch = validate_moe_dispatch(
+            normalize_moe_dispatch(self.moe_dispatch))
 
 
 class MixtralForCausalLM(LlamaForCausalLM):
@@ -94,6 +104,7 @@ class MixtralForCausalLM(LlamaForCausalLM):
             capacity_factor=cfg.moe_capacity_factor,
             group_size=cfg.moe_group_size,
             compute_dtype=self.compute_dtype,
+            dispatch=cfg.moe_dispatch,
         )
 
     def _combine_aux(self, aux_losses):

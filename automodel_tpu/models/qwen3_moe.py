@@ -55,6 +55,12 @@ class Qwen3MoeConfig(MixtralConfig):
 class Qwen3MoeForCausalLM(MixtralForCausalLM):
     """Qwen3 attention x Mixtral expert dispatch.
 
+    The ``router_aux_loss_coef`` load-balancing penalty rides the inherited
+    ``MixtralForCausalLM._combine_aux`` (HF gating: folded into the training
+    loss iff ``output_router_logits`` is on — ``modeling_qwen3_moe.py``
+    adds ``coef * load_balancing_loss_func(...)`` under exactly that flag);
+    the regression lives in ``tests/unit_tests/test_moe_dispatch.py``.
+
     Param tree per layer (stacked over ``L``):
       ``mlp/gate/kernel``               [L, H, E]
       ``mlp/experts/gate_proj/kernel``  [L, E, H, I_moe]
@@ -110,6 +116,7 @@ class Qwen3MoeForCausalLM(MixtralForCausalLM):
             group_size=cfg.moe_group_size,
             compute_dtype=self.compute_dtype,
             norm_topk=bool(cfg.norm_topk_prob),
+            dispatch=cfg.moe_dispatch,
         )
 
     def flops_per_token(self) -> float:

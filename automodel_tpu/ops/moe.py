@@ -2,18 +2,30 @@
 
 What the reference gets from HF transformers' ``MixtralSparseMoeBlock``
 (eager per-expert gather/scatter driven by ``torch.where`` — fine on GPU,
-shape-dynamic and serial) is here the GShard/Switch dispatch-combine
-formulation: routing builds **static-shape** dispatch/combine tensors and
-expert FFNs run as one batched einsum over the expert dim, so the MXU sees
-E large matmuls and XLA can shard the expert dim over the mesh
-(expert parallelism) with compile-time collectives.
+shape-dynamic and serial) is here TWO static-shape formulations behind one
+``moe.dispatch`` knob:
+
+* ``sorted`` (default) — sort-based dropless dispatch in the MegaBlocks /
+  MaxText-megablox mold: argsort the ``[T*k]`` routed assignments by expert
+  id, run the SwiGLU expert FFNs as ONE grouped matmul over the sorted
+  token buffer (``ops/gmm_kernel.py`` — Pallas on TPU, block-segment einsum
+  fallback elsewhere), scatter-add back with the combine weights.
+  ``O(T*k)`` matmul rows and no tensor carries an ``E`` dim, so compute is
+  independent of the expert count — the integer-factor win at Qwen3-scale
+  E=128 where dispatch/combine einsums otherwise dwarf the FFN FLOPs.
+* ``onehot`` — the GShard/Switch dispatch-combine formulation: routing
+  builds ``[G, M, E, C]`` dispatch/combine one-hots contracted with
+  einsums.  Kept as the parity ORACLE (bit-for-bit the semantics HF
+  reproduces) and for debugging; the sorted path must match it exactly,
+  drops included.
 
 Parity target: ``transformers`` Mixtral routing semantics
 (``modeling_mixtral.py``: softmax over all experts in fp32 -> top-k ->
-renormalize) and its ``load_balancing_loss_func``.  With sufficient capacity
-the dispatch-combine result is exactly the reference's dropless computation;
-under a finite ``capacity_factor`` tokens over capacity are dropped
-(GShard semantics) — the residual stream passes them through unchanged.
+renormalize) and its ``load_balancing_loss_func``.  With
+``capacity_factor=None`` both dispatches are exactly the reference's
+dropless computation; under a finite ``capacity_factor`` tokens over
+capacity are dropped (GShard slot-major priority — identical drop decisions
+on both paths) and the residual stream passes them through unchanged.
 """
 
 from __future__ import annotations
@@ -26,6 +38,35 @@ import jax.numpy as jnp
 from jax import lax
 
 from automodel_tpu.distributed.shardings import constrain
+
+# ``moe.dispatch`` knob (config-load enum-validated like cp_layout; null
+# spellings mean "use the default").
+MOE_DISPATCHES = ("sorted", "onehot")
+DEFAULT_MOE_DISPATCH = "sorted"
+
+
+def normalize_moe_dispatch(dispatch: Optional[str]) -> Optional[str]:
+    """Map YAML null spellings to None (single rule:
+    ``config/loader.normalize_null_spelling``)."""
+    from automodel_tpu.config.loader import normalize_null_spelling
+
+    return normalize_null_spelling(dispatch)
+
+
+def validate_moe_dispatch(dispatch: Optional[str]) -> Optional[str]:
+    """None (defer to the default) or a member of MOE_DISPATCHES."""
+    if dispatch is None:
+        return None
+    if dispatch not in MOE_DISPATCHES:
+        raise ValueError(
+            f"moe.dispatch must be one of {list(MOE_DISPATCHES)}, "
+            f"got {dispatch!r}")
+    return dispatch
+
+
+def resolve_moe_dispatch(dispatch: Optional[str]) -> str:
+    validate_moe_dispatch(dispatch)
+    return dispatch if dispatch is not None else DEFAULT_MOE_DISPATCH
 
 
 def topk_routing(router_logits: jnp.ndarray, k: int, norm_topk: bool = True
@@ -45,9 +86,16 @@ def topk_routing(router_logits: jnp.ndarray, k: int, norm_topk: bool = True
 
 
 def routing_stats(probs: jnp.ndarray, expert_idx: jnp.ndarray,
-                  num_experts: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                  num_experts: int,
+                  valid_tokens: Optional[jnp.ndarray] = None,
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-call routing statistics for the Switch aux loss:
     ``(tokens_per_expert [k, E], router_prob [E])``, means over tokens.
+
+    ``valid_tokens`` (same shape as the token dims, 0/1) excludes padding
+    rows added by :func:`group_tokens` — sentinel expert ids already one-hot
+    to zero, and the means divide by the REAL token count so pad rows can
+    never dilute the loss.
 
     Kept separate from the loss product because HF's
     ``load_balancing_loss_func`` concatenates ALL layers' tokens before the
@@ -55,9 +103,18 @@ def routing_stats(probs: jnp.ndarray, expert_idx: jnp.ndarray,
     stats across layers first (mean of products != product of means)."""
     mask = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.float32)
     token_axes = tuple(range(mask.ndim - 2))        # all but (k, E)
-    tokens_per_expert = jnp.mean(mask, axis=token_axes)          # [k, E]
-    router_prob = jnp.mean(probs.astype(jnp.float32),
-                           axis=tuple(range(probs.ndim - 1)))    # [E]
+    probs = probs.astype(jnp.float32)
+    if valid_tokens is None:
+        tokens_per_expert = jnp.mean(mask, axis=token_axes)          # [k, E]
+        router_prob = jnp.mean(probs,
+                               axis=tuple(range(probs.ndim - 1)))    # [E]
+    else:
+        v = valid_tokens.astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(v), 1.0)
+        tokens_per_expert = jnp.sum(mask, axis=token_axes) / denom
+        router_prob = jnp.sum(
+            probs * v[..., None],
+            axis=tuple(range(probs.ndim - 1))) / denom
     return tokens_per_expert, router_prob
 
 
@@ -69,12 +126,46 @@ def load_balancing_loss(tokens_per_expert: jnp.ndarray,
 
 
 def _group_size(tokens: int, requested: int) -> int:
-    """Largest divisor of ``tokens`` that is <= requested (dispatch tensors
-    are sized per group, so groups bound routing memory)."""
-    m = min(requested, tokens)
-    while tokens % m:
-        m -= 1
-    return m
+    """Tokens per group.  The token dim is PADDED up to a multiple of the
+    result (:func:`group_tokens`), so the requested size is honored exactly
+    whenever ``tokens >= requested`` — the old largest-divisor search
+    collapsed M toward 1 for prime/awkward token counts (G -> T one-token
+    groups, catastrophic dispatch overhead)."""
+    return min(requested, tokens)
+
+
+def group_tokens(x2d: jnp.ndarray, group_size: int
+                 ) -> Tuple[jnp.ndarray, int]:
+    """``[T, H] -> ([G, M, H], pad)`` with ``M = _group_size(T, group_size)``
+    and ``pad = G*M - T`` zero rows appended.  Callers must mask pad tokens
+    out of routing (:func:`mask_padded_tokens`) and slice them off the
+    output."""
+    T, H = x2d.shape
+    M = _group_size(T, group_size)
+    G = -(-T // M)
+    pad = G * M - T
+    if pad:
+        x2d = jnp.pad(x2d, ((0, pad), (0, 0)))
+    return x2d.reshape(G, M, H), pad
+
+
+def mask_padded_tokens(weights: jnp.ndarray, idx: jnp.ndarray, pad: int,
+                       num_experts: int
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                  Optional[jnp.ndarray]]:
+    """Route the ``pad`` trailing tokens of the flattened ``[G*M]`` stream
+    to the SENTINEL expert id E with zero combine weight: a sentinel
+    one-hots to the zero vector (consumes no capacity, joins no dispatch)
+    and the sorted path sorts it past every real segment.  Returns
+    ``(weights, idx, valid [G, M] | None)``."""
+    if not pad:
+        return weights, idx, None
+    G, M = idx.shape[:2]
+    valid = (jnp.arange(G * M, dtype=jnp.int32) < G * M - pad).reshape(G, M)
+    idx = jnp.where(valid[..., None], idx, num_experts)
+    weights = jnp.where(valid[..., None], weights,
+                        jnp.zeros((), weights.dtype))
+    return weights, idx, valid
 
 
 def group_and_capacity(tokens: int, group_size: int, num_experts: int,
@@ -102,6 +193,7 @@ def moe_mlp_block(
     group_size: int = 512,
     compute_dtype: jnp.dtype = jnp.bfloat16,
     norm_topk: bool = True,
+    dispatch: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """Top-k routed SwiGLU expert FFN.  Returns ``(out [B, S, H],
     (tokens_per_expert [k, E], router_prob [E]))`` — see
@@ -109,8 +201,11 @@ def moe_mlp_block(
 
     ``capacity_factor=None`` means lossless: per-group expert capacity is the
     group size itself, so no assignment can overflow — exact HF parity at
-    E/k x the minimal expert FLOPs.  The finite default (2.0) is the
-    standard train-time trade: capacity ``C = ceil(k*M/E * cf)``.
+    the minimal expert FLOPs.  The finite default (2.0) is the standard
+    train-time trade: capacity ``C = ceil(k*M/E * cf)``.
+
+    ``dispatch``: ``sorted`` (default) | ``onehot`` — see the module
+    docstring and :func:`expert_ffn`.
     """
     B, S, H = x.shape
     E = gate_kernel.shape[-1]
@@ -118,9 +213,8 @@ def moe_mlp_block(
     cd = compute_dtype
     T = B * S
     M, C = group_and_capacity(T, group_size, E, k, capacity_factor)
-    G = T // M
 
-    xg = x.reshape(G, M, H)
+    xg, pad = group_tokens(x.reshape(T, H), M)
     # Token dim gathers every batch-ish mesh axis (dp x cp): routing is
     # per-token, so the merged [B*S] layout keeps dispatch local to shards.
     xg = constrain(xg, ("act_tokens", None, None))
@@ -129,10 +223,37 @@ def moe_mlp_block(
     router_logits = xg.astype(jnp.float32) @ gate_kernel.astype(jnp.float32)
     weights, idx, probs = topk_routing(router_logits, k,
                                        norm_topk=norm_topk)     # [G, M, k]
-    aux = routing_stats(probs, idx, E)
-    out = expert_dispatch_ffn(xg, weights, idx, w_gate, w_up, w_down,
-                              capacity=C, compute_dtype=cd)
+    weights, idx, valid = mask_padded_tokens(weights, idx, pad, E)
+    aux = routing_stats(probs, idx, E, valid_tokens=valid)
+    out = expert_ffn(xg, weights, idx, w_gate, w_up, w_down,
+                     capacity=C, dispatch=dispatch, compute_dtype=cd)
+    out = out.reshape(-1, H)
+    if pad:
+        out = out[:T]
     return out.reshape(B, S, H), aux
+
+
+def expert_ffn(
+    xg: jnp.ndarray,          # [G, M, H] grouped tokens
+    weights: jnp.ndarray,     # [G, M, k] combine weights
+    idx: jnp.ndarray,         # [G, M, k] expert assignment (E = pad sentinel)
+    w_gate: jnp.ndarray,      # [E, H, I]
+    w_up: jnp.ndarray,        # [E, H, I]
+    w_down: jnp.ndarray,      # [E, I, H]
+    *,
+    capacity: int,
+    dispatch: Optional[str] = None,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> jnp.ndarray:
+    """Routing-agnostic expert-FFN dispatcher (shared by Mixtral softmax
+    top-k and the DeepSeek sigmoid/softmax gates): ``sorted`` grouped-matmul
+    path by default, ``onehot`` GShard dispatch/combine as the oracle."""
+    if resolve_moe_dispatch(dispatch) == "onehot":
+        return expert_dispatch_ffn(xg, weights, idx, w_gate, w_up, w_down,
+                                   capacity=capacity,
+                                   compute_dtype=compute_dtype)
+    return sorted_expert_ffn(xg, weights, idx, w_gate, w_up, w_down,
+                             capacity=capacity, compute_dtype=compute_dtype)
 
 
 def expert_dispatch_ffn(
@@ -146,9 +267,8 @@ def expert_dispatch_ffn(
     capacity: int,
     compute_dtype: jnp.dtype = jnp.bfloat16,
 ) -> jnp.ndarray:
-    """Static-shape dispatch/combine + expert-batched SwiGLU FFN (the
-    routing-agnostic core shared by Mixtral softmax-top-k and DeepSeek
-    sigmoid no-aux routing)."""
+    """Static-shape dispatch/combine + expert-batched SwiGLU FFN — the
+    GShard one-hot formulation, kept as the sorted path's parity oracle."""
     G, M, H = xg.shape
     E = w_gate.shape[0]
     k = idx.shape[-1]
@@ -178,6 +298,112 @@ def expert_dispatch_ffn(
     expert_out = jnp.einsum("egci,eih->egch", h_act, w_down.astype(cd))
     expert_out = constrain(expert_out, ("experts", "act_tokens", None, None))
     return jnp.einsum("egch,gmec->gmh", expert_out, combine)
+
+
+def _assignment_positions(idx: jnp.ndarray, num_experts: int) -> jnp.ndarray:
+    """``[G, M, k] -> [G, M, k]`` GShard slot-major position of each routed
+    assignment in its (group, expert) capacity queue — the EXACT priority
+    ``expert_dispatch_ffn`` uses, so capacity drops are decided identically
+    on both dispatch paths.  ``O(T*E*k)`` int ops, no ``[.., E, C]``
+    tensors."""
+    G, M, k = idx.shape
+    counts = jnp.zeros((G, 1, num_experts), jnp.int32)
+    pos = []
+    for j in range(k):
+        oh = jax.nn.one_hot(idx[..., j], num_experts, dtype=jnp.int32)
+        pj = jnp.cumsum(oh, axis=1) - oh + counts               # [G, M, E]
+        counts = counts + jnp.sum(oh, axis=1, keepdims=True)
+        pos.append(jnp.sum(pj * oh, axis=-1))                   # [G, M]
+    return jnp.stack(pos, axis=-1)
+
+
+def sorted_expert_ffn(
+    xg: jnp.ndarray,          # [G, M, H] grouped tokens
+    weights: jnp.ndarray,     # [G, M, k] combine weights
+    idx: jnp.ndarray,         # [G, M, k] expert assignment (E = pad sentinel)
+    w_gate: jnp.ndarray,      # [E, H, I]
+    w_up: jnp.ndarray,        # [E, H, I]
+    w_down: jnp.ndarray,      # [E, I, H]
+    *,
+    capacity: Optional[int] = None,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+    block_rows: int = 128,
+) -> jnp.ndarray:
+    """Sort-based expert FFN: ``O(T*k*H*I)`` compute, no ``[.., E, C]``
+    tensors.
+
+    1. Decide capacity drops with the oracle's slot-major priority
+       (``capacity >= M`` — the lossless/dropless case — skips this
+       entirely) and send dropped/pad assignments to the sentinel id E.
+    2. Stable-argsort the ``[G*M*k]`` assignments by expert id and build
+       per-expert group sizes; each expert's segment is placed at a
+       ``block_rows``-aligned offset (static ``N + E*block_rows`` buffer) so
+       the grouped matmul tiles never straddle a ragged boundary and the
+       XLA fallback stays ``O(N)`` (``gmm_kernel._gmm_xla_blocked``).
+    3. Run the SwiGLU expert FFNs as grouped matmuls over the sorted buffer.
+    4. Scatter-add back through the combine weights (dropped/pad slots carry
+       weight 0 and rows past the segments are zeroed by ``gmm``).
+
+    Sharding: the sorted token buffer keeps the merged-token layout
+    (``act_tokens`` over dp/cp mesh axes); expert weights keep their
+    ``experts``/``expert_mlp`` parameter axes, so the existing
+    ``expert_parallel`` rules in ``distributed/shardings.py`` apply
+    unchanged.
+    """
+    G, M, H = xg.shape
+    E = w_gate.shape[0]
+    k = idx.shape[-1]
+    T = G * M
+    N = T * k
+    cd = compute_dtype
+    B = int(block_rows)
+
+    if capacity is not None and capacity < M:
+        pos = _assignment_positions(idx, E)
+        eid = jnp.where(pos < capacity, idx, E)
+    else:
+        eid = idx
+    eid_flat = eid.reshape(N)
+    order = jnp.argsort(eid_flat)           # stable: ties keep token order
+    sizes = jnp.bincount(eid_flat, length=E + 1)[:E].astype(jnp.int32)
+
+    # Block-aligned segment layout: expert e's rows live at
+    # [seg_off[e], seg_off[e] + sizes[e]) with seg_off a multiple of B.
+    padded = -(-sizes // B) * B
+    n_pad = -(-(N + E * B) // B) * B        # static upper bound
+    seg_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(padded)[:-1]])
+    raw_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(sizes)[:-1]])
+
+    rows = jnp.arange(n_pad, dtype=jnp.int32)
+    gid = jnp.searchsorted(seg_off + padded, rows, side="right")
+    gid_c = jnp.minimum(gid, E - 1)
+    rank = rows - jnp.take(seg_off, gid_c)
+    in_seg = (gid < E) & (rank < jnp.take(sizes, gid_c))
+    slot = jnp.take(raw_off, gid_c) + jnp.minimum(
+        rank, jnp.maximum(jnp.take(sizes, gid_c) - 1, 0))
+    src = jnp.take(order, jnp.clip(slot, 0, N - 1))     # assignment index
+    tok = src // k                                      # source token row
+
+    x_flat = xg.reshape(T, H)
+    x_sorted = jnp.where(in_seg[:, None], jnp.take(x_flat, tok, axis=0),
+                         jnp.zeros((), x_flat.dtype)).astype(cd)
+    x_sorted = constrain(x_sorted, ("act_tokens", None))
+
+    from automodel_tpu.ops.gmm_kernel import gmm
+
+    wg, wu, wd = (w.astype(cd) for w in (w_gate, w_up, w_down))
+    h_gate = gmm(x_sorted, wg, padded, block_aligned=True, block_rows=B)
+    h_up = gmm(x_sorted, wu, padded, block_aligned=True, block_rows=B)
+    h_act = constrain(jax.nn.silu(h_gate) * h_up, ("act_tokens", "expert_mlp"))
+    out_sorted = gmm(h_act, wd, padded, block_aligned=True, block_rows=B)
+    out_sorted = constrain(out_sorted, ("act_tokens", None))
+
+    w_sorted = jnp.where(in_seg, jnp.take(weights.reshape(N), src),
+                         jnp.zeros((), weights.dtype)).astype(cd)
+    out = jnp.zeros((T, H), cd).at[tok].add(out_sorted * w_sorted[:, None])
+    return constrain(out.reshape(G, M, H), ("act_tokens", None, None))
 
 
 def noaux_topk_routing(

@@ -53,11 +53,11 @@ _PASSTHROUGH_KEYS = frozenset({
 
 
 def normalize_cp_layout(layout: Optional[str]) -> Optional[str]:
-    """Map the YAML null spellings ("none"/"null"/"") to None — the single
-    place that knows them; mesh/recipes/loader all reuse this."""
-    if isinstance(layout, str) and layout.lower() in ("none", "null", ""):
-        return None
-    return layout
+    """Map the YAML null spellings to None (single rule:
+    ``config/loader.normalize_null_spelling``); mesh/recipes reuse this."""
+    from automodel_tpu.config.loader import normalize_null_spelling
+
+    return normalize_null_spelling(layout)
 
 
 def validate_cp_layout(layout: Optional[str]) -> Optional[str]:

@@ -69,23 +69,39 @@ class BaseRecipe:
         fault_point("ckpt_pre_save")
         path = ckpt.prepare_staging(final, cfg)  # collective
 
-        # model weights (collective)
-        if getattr(self, "params", None) is not None:
-            ckpt.save_model(self.model, self.params,
-                            os.path.join(path, "model"), cfg,
-                            peft_config=getattr(self, "peft_config", None))
-        # optimizer + LR scheduler (collective)
-        if getattr(self, "opt_state", None) is not None:
-            ckpt.save_optimizer(self.opt_state, os.path.join(path, "optim"),
-                                scheduler=getattr(self, "lr_scheduler", None),
-                                config=cfg)
+        # COLLECTIVE writers (model weights, optimizer) under the same
+        # try/vote discipline as the host-side writes below: an exception
+        # raised here on ONE host would skip that host's
+        # ``ckpt:host_writes_ok`` vote while its peers — whose collective
+        # save calls completed locally — sit in the vote barrier forever.
+        # Catching and voting turns one failing host into a lockstep abort
+        # on every host.  (The vote itself is the first collective the
+        # failing host still participates in.)
+        host_err = None
+        try:
+            fault_point("ckpt_collective_save")
+            # model weights (collective)
+            if getattr(self, "params", None) is not None:
+                ckpt.save_model(self.model, self.params,
+                                os.path.join(path, "model"), cfg,
+                                peft_config=getattr(self, "peft_config",
+                                                    None))
+            # optimizer + LR scheduler (collective)
+            if getattr(self, "opt_state", None) is not None:
+                ckpt.save_optimizer(
+                    self.opt_state, os.path.join(path, "optim"),
+                    scheduler=getattr(self, "lr_scheduler", None),
+                    config=cfg)
+        except Exception as e:
+            host_err = e
+            logger.exception(
+                "collective checkpoint writes failed for %s", final)
         # host-side statefuls + config on process 0.  Failures here (retries
         # exhausted) are caught and put to a collective vote instead of
         # raised: raising past commit_checkpoint's barrier would leave every
         # peer host hanging in it, turning one bad disk into a silently hung
         # pool.  All hosts abort (or commit) in lockstep.
-        host_err = None
-        if is_main:
+        if is_main and host_err is None:
             try:
                 for key, obj in self._state_tracked.items():
                     if key in ("lr_scheduler",):
@@ -117,7 +133,7 @@ class BaseRecipe:
             note = f"; staging left at {path} for inspection"
             if host_err is not None:
                 raise ckpt.CheckpointSaveError(
-                    f"aborting commit of {final}: host-side writes failed "
+                    f"aborting commit of {final}: checkpoint writes failed "
                     f"on this host{note}") from host_err
             raise ckpt.CheckpointSaveError(
                 f"aborting commit of {final}: a peer host failed its "

@@ -247,6 +247,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         # out of the zig-zag permutation via ``zigzag_cp_safe = False``)
         self.model = build_model(cfg.get("model"))
         self._apply_cp_layout_policy()
+        self._apply_moe_dispatch_policy()
         self.plan = build_parallel_plan(self.model, self.mesh_manager)
         self.param_sharding = self.plan.param_sharding
 
@@ -464,6 +465,36 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                         cp, layout,
                         " (causal load-balanced ring, masked kv tiles "
                         "skipped)" if layout == "zigzag" else "")
+
+    def _apply_moe_dispatch_policy(self):
+        """Thread the top-level ``moe.dispatch`` knob ({sorted, onehot};
+        enum-validated at config load like ``distributed.cp_layout``) into
+        the model config.  Models resolve None to the sorted default at
+        call time (``ops/moe.py``), so this only acts on an explicit
+        choice; asking for it on a non-MoE model is a loud error — the knob
+        would otherwise silently do nothing."""
+        from automodel_tpu.ops.moe import (
+            normalize_moe_dispatch,
+            validate_moe_dispatch,
+        )
+
+        dispatch = validate_moe_dispatch(
+            normalize_moe_dispatch(self.cfg.get("moe.dispatch")))
+        if dispatch is None:
+            return
+        cfg_obj = getattr(self.model, "config", None)
+        if not hasattr(cfg_obj, "moe_dispatch"):
+            raise ValueError(
+                f"moe.dispatch={dispatch!r} set but "
+                f"{type(self.model).__name__} has no routed-expert block "
+                "(no model.config.moe_dispatch) — remove the knob or pick "
+                "an MoE model family")
+        cfg_obj.moe_dispatch = dispatch
+        if self.dist_info.is_main:
+            logger.info("MoE expert dispatch: %s%s", dispatch,
+                        " (sort-based grouped matmuls)"
+                        if dispatch == "sorted" else
+                        " (GShard one-hot dispatch/combine oracle)")
 
     # -- overridable setup hooks (the VLM recipe swaps these) ---------------
     def _build_freeze_mask(self):

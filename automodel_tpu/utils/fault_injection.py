@@ -23,6 +23,11 @@ construction, no randomness anywhere.
 Registered checkpoint-path points (see ``BaseRecipe.save_checkpoint``):
 
     ckpt_pre_save     before the staging directory is prepared
+    ckpt_collective_save
+                      inside the COLLECTIVE phase (before the
+                      save_model/save_optimizer writers) — exercises the
+                      try/vote wrap that keeps a failing host from
+                      stranding peers at the commit barrier
     ckpt_pre_commit   after all state is written, before the manifest
     ckpt_pre_rename   after the manifest, before the atomic rename
     ckpt_post_commit  after the rename, before retention GC

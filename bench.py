@@ -19,6 +19,9 @@ scale, each in its own subprocess (fresh HBM):
   * ``quant_int8``— int8 quantized COMPUTE (the reference's fp8 role);
   * ``long_context_16k`` — 16k packed tokens per row (splash causal block
     skipping + remat; attention-dominated, so tok/s only);
+  * ``moe``       — tiny Qwen3-MoE shape (E=8, k=2, dropless): sorted
+    grouped-matmul dispatch tok/s, ``moe_vs_baseline`` = sorted/onehot
+    ratio (``BENCH_MOE_DISPATCH`` pins one path);
   * ``vlm``       — Gemma-3-VL scale-down (config #4: SigLIP tower +
     Gemma text decoder) at S=2048; reports ``vlm_vs_baseline`` = MFU/0.40
     with BOTH towers' FLOPs accounted.
@@ -120,6 +123,13 @@ SECONDARY = {
     # (2048 under BENCH_SMALL), sized for the virtual-CPU mesh; use 16384
     # on a real slice for the leg's nominal long-context shape.
     "long_context_16k_cp": [],
+    # MoE leg: handled by _moe_secondary_main — a tiny Qwen3-MoE-shaped
+    # model (E=8, k=2, dropless) through the jitted train step under BOTH
+    # expert dispatches.  Reports sorted tok/s, with _vs_baseline = sorted
+    # tok/s / onehot tok/s (the sort-based grouped-matmul win over the
+    # GShard one-hot dispatch).  ``BENCH_MOE_DISPATCH=sorted|onehot`` pins
+    # one path (no ratio).
+    "moe": [],
 }
 
 
@@ -274,10 +284,76 @@ def _cp_secondary_main() -> None:
                       "vs_baseline": round(zig / contig, 4)}))
 
 
+def _moe_secondary_main() -> None:
+    """Child process: the MoE expert-dispatch leg on one device.
+
+    Times the REAL jitted train step (routing + expert FFNs + aux loss +
+    optimizer) on a tiny Qwen3-MoE-shaped model (E=8, k=2, every layer
+    sparse, ``moe_capacity_factor: None`` — the dropless regime both
+    dispatches compute exactly) under ``moe.dispatch=sorted`` and
+    ``onehot``.  Absolute tok/s on a dev host is not chip-meaningful; the
+    sorted/onehot RATIO is the metric (reported as the leg's vs_baseline).
+    ``BENCH_MOE_DISPATCH`` pins one path (no ratio).
+    """
+    import jax
+
+    from automodel_tpu.models.qwen3_moe import (
+        Qwen3MoeConfig,
+        Qwen3MoeForCausalLM,
+    )
+    from automodel_tpu.loss.masked_ce import IGNORE_INDEX
+    from automodel_tpu.optim import build_optimizer
+    from automodel_tpu.training.train_step import build_train_step
+
+    steps, warmup = (2, 1) if SMALL else (4, 1)
+    B, S = (2, 256) if SMALL else (4, 512)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 255, (1, B, S))          # [A=1 grad-acc, B, S]
+    labels = np.roll(ids, -1, -1)
+    labels[..., -1] = IGNORE_INDEX
+    stacked = {"input_ids": ids.astype(np.int32),
+               "labels": labels.astype(np.int32)}
+
+    def run(dispatch: str) -> float:
+        model = Qwen3MoeForCausalLM(
+            Qwen3MoeConfig(
+                vocab_size=2048, hidden_size=256, intermediate_size=512,
+                moe_intermediate_size=512, num_hidden_layers=2,
+                num_attention_heads=4, num_key_value_heads=2, head_dim=64,
+                rope_theta=10000.0, tie_word_embeddings=False,
+                num_experts=8, num_experts_per_tok=2,
+                output_router_logits=True, moe_capacity_factor=None,
+                moe_group_size=512, moe_dispatch=dispatch))
+        fns = build_train_step(model, build_optimizer(name="adamw", lr=1e-3))
+        params = model.init(jax.random.key(0))
+        opt_state = fns.init_opt_state(params)
+        batch = jax.device_put(dict(stacked), fns.microbatch_sharding)
+        for _ in range(warmup):
+            params2, opt2, m = fns.train_step(params, opt_state, batch)
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params2, opt2, m = fns.train_step(params2, opt2, batch)
+        jax.block_until_ready(m["loss"])
+        assert np.isfinite(float(m["loss"]))
+        return steps * ids.size / (time.perf_counter() - t0)
+
+    pinned = os.environ.get("BENCH_MOE_DISPATCH", "")
+    if pinned:
+        print(json.dumps({"tps": round(run(pinned), 1)}))
+        return
+    onehot = run("onehot")
+    srt = run("sorted")
+    print(json.dumps({"tps": round(srt, 1),
+                      "vs_baseline": round(srt / onehot, 4)}))
+
+
 def _secondary_main(name: str) -> None:
     """Child process: one secondary config, prints {"tps": ...}."""
     if name == "long_context_16k_cp":
         return _cp_secondary_main()
+    if name == "moe":
+        return _moe_secondary_main()
     steps, warmup = (4, 2) if SMALL else (8, 3)
     if name == "unpacked" and not SMALL:
         # two length buckets (1024/1152) after the 128-alignment: warm both

@@ -125,6 +125,38 @@ def test_interrupted_save_invisible_then_clean_save_gcs(tmp_path):
     assert ckpt.find_latest_checkpoint(str(tmp_path)).endswith("epoch_0_step_3")
 
 
+def test_collective_phase_failure_aborts_before_commit_barrier(tmp_path):
+    """Multihost hardening (ISSUE 4 satellite / ROADMAP open item): a
+    failure inside the COLLECTIVE ``save_model``/``save_optimizer`` phase
+    must be caught and put to the ``ckpt:host_writes_ok`` vote — raising
+    past it would strand peer hosts at the commit barrier.  Observable
+    single-host contract: the injected fault surfaces as a
+    ``CheckpointSaveError`` (the vote-abort path, NOT the raw
+    ``InjectedFault`` unwinding past the barrier), nothing commits, and
+    the next clean save succeeds."""
+    r = _TinyRecipe(tmp_path)
+    r.counter.value = 5
+    committed = r.save_checkpoint(0, 1)
+
+    fi.configure_faults("ckpt_collective_save:1")
+    r.counter.value = 6
+    with pytest.raises(ckpt.CheckpointSaveError) as ei:
+        r.save_checkpoint(0, 2)
+    # the vote path chains the real failure for the log/traceback
+    assert isinstance(ei.value.__cause__, fi.InjectedFault)
+    # host-side statefuls were never written (collective phase comes first)
+    assert "epoch_0_step_2" not in _dirs(tmp_path)
+    assert "epoch_0_step_2.tmp" in _dirs(tmp_path)
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) == committed
+
+    fi.reset_faults()
+    committed_2 = r.save_checkpoint(0, 2)
+    assert ckpt.is_committed(committed_2)
+    fresh = _TinyRecipe(tmp_path)
+    fresh.load_checkpoint()
+    assert fresh.counter.value == 6
+
+
 def test_resave_interrupted_at_rename_preserves_old_payload(tmp_path):
     """Replacing a committed checkpoint at the same (epoch, step) must not
     rmtree it before the new one lands: a kill inside the rename window

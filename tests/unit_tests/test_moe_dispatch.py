@@ -1,0 +1,503 @@
+"""Sort-based dropless MoE dispatch (ISSUE 4): sorted-vs-onehot parity
+(outputs AND grads, across capacity factors / k / pathological loads), the
+grouped-matmul kernel (interpret mode + block-segment XLA fallback), the
+token-padding grouping fix, direct routing-function units, the
+``moe.dispatch`` enum guards, and the expert-parallel layout audit.
+
+The ``onehot`` GShard dispatch/combine path is the ORACLE — it is pinned
+bit-for-bit against HF transformers by ``test_mixtral.py`` /
+``test_deepseek_v3.py`` — so sorted==onehot here transitively means
+sorted==HF, drops included.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import automodel_tpu.ops.gmm_kernel as gk
+from automodel_tpu.ops import moe
+
+
+def _weights(key, H, I, E, dtype=jnp.float32):
+    ks = jax.random.split(key, 4)
+    return (jax.random.normal(ks[0], (H, E), dtype) * 0.1,
+            jax.random.normal(ks[1], (E, H, I), dtype) * 0.05,
+            jax.random.normal(ks[2], (E, H, I), dtype) * 0.05,
+            jax.random.normal(ks[3], (E, I, H), dtype) * 0.05)
+
+
+def _routed(key, G, M, H, E, k, skew=0.0):
+    """Grouped tokens + routing; ``skew`` biases the router toward low
+    expert ids for uneven loads."""
+    xk, _ = jax.random.split(key)
+    xg = jax.random.normal(xk, (G, M, H), jnp.float32)
+    gate = jax.random.normal(jax.random.fold_in(key, 1), (H, E), jnp.float32)
+    logits = xg @ gate - skew * jnp.arange(E, dtype=jnp.float32)
+    return xg, moe.topk_routing(logits, k)
+
+
+# ---------------------------------------------------------------------------
+# Sorted vs onehot parity: the acceptance matrix
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("cf", [None, 1.0, 2.0])
+@pytest.mark.parametrize("k", [1, 2, 8])
+def test_sorted_matches_onehot_outputs_and_grads(cf, k):
+    G, M, H, I, E = 2, 64, 16, 24, 8
+    xg, (w8, idx, _) = _routed(jax.random.key(k), G, M, H, E, k, skew=0.3)
+    _, wg, wu, wd = _weights(jax.random.key(10 + k), H, I, E)
+    _, C = moe.group_and_capacity(G * M, M, E, k, cf)
+
+    def run(dispatch, xg, wg, wu, wd):
+        return moe.expert_ffn(xg, w8, idx, wg, wu, wd, capacity=C,
+                              dispatch=dispatch,
+                              compute_dtype=jnp.float32)
+
+    ref = run("onehot", xg, wg, wu, wd)
+    out = run("sorted", xg, wg, wu, wd)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+    def loss(d, xg, wg, wu, wd):
+        return jnp.sum(run(d, xg, wg, wu, wd) ** 2)
+
+    g_ref = jax.grad(loss, argnums=(1, 2, 3, 4))("onehot", xg, wg, wu, wd)
+    g_new = jax.grad(loss, argnums=(1, 2, 3, 4))("sorted", xg, wg, wu, wd)
+    for a, b in zip(g_new, g_ref):
+        scale = max(float(jnp.max(jnp.abs(b))), 1.0)
+        np.testing.assert_allclose(np.asarray(a) / scale,
+                                   np.asarray(b) / scale, atol=1e-3)
+
+
+def test_sorted_matches_onehot_hotspot_all_tokens_one_expert():
+    """Worst-case load: every token's top choice is one expert (heavy drops
+    under cf=1.0 decided by GShard slot-major priority on both paths)."""
+    G, M, H, I, E, k = 2, 64, 16, 24, 8, 2
+    xg, (w8, idx, _) = _routed(jax.random.key(0), G, M, H, E, k)
+    idx = jnp.full_like(idx, 3).at[..., 1].set(5)   # hot experts 3 and 5
+    _, wg, wu, wd = _weights(jax.random.key(1), H, I, E)
+    for cf in (None, 1.0):
+        _, C = moe.group_and_capacity(G * M, M, E, k, cf)
+        ref = moe.expert_dispatch_ffn(xg, w8, idx, wg, wu, wd, capacity=C,
+                                      compute_dtype=jnp.float32)
+        out = moe.sorted_expert_ffn(xg, w8, idx, wg, wu, wd, capacity=C,
+                                    compute_dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_dispatch_enum_and_default():
+    assert moe.resolve_moe_dispatch(None) == "sorted"
+    assert moe.resolve_moe_dispatch("onehot") == "onehot"
+    assert moe.normalize_moe_dispatch("null") is None
+    with pytest.raises(ValueError, match="moe.dispatch"):
+        moe.resolve_moe_dispatch("blocktree")
+
+
+# ---------------------------------------------------------------------------
+# Grouped matmul kernel: interpret-mode Pallas + XLA fallbacks
+# ---------------------------------------------------------------------------
+def _ref_gmm(lhs, rhs, sizes):
+    out = np.zeros((lhs.shape[0], rhs.shape[-1]), np.float32)
+    s = 0
+    for e, sz in enumerate(sizes):
+        out[s:s + sz] = np.asarray(lhs)[s:s + sz] @ np.asarray(rhs)[e]
+        s += sz
+    return out
+
+
+@pytest.mark.parametrize("sizes", [
+    [13, 0, 27, 1, 23],       # straddles + an empty group
+    [64, 0, 0, 0, 0],         # one group takes everything
+    [0, 0, 0, 0, 40],         # leading empties + dropped tail rows
+    [8, 8, 8, 8, 8],
+])
+def test_gmm_pallas_interpret_matches_reference(monkeypatch, sizes):
+    monkeypatch.setattr(gk, "_INTERPRET", True)
+    rng = np.random.default_rng(0)
+    m, k, n, E = 64, 16, 16, 5
+    lhs = jnp.asarray(rng.normal(size=(m, k)), jnp.float32)
+    rhs = jnp.asarray(rng.normal(size=(E, k, n)), jnp.float32)
+    gs = jnp.asarray(sizes, jnp.int32)
+    out = jax.jit(gk.gmm)(lhs, rhs, gs)
+    np.testing.assert_allclose(np.asarray(out), _ref_gmm(lhs, rhs, sizes),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_gmm_pallas_trailing_empty_group_exactly_full_buffer(monkeypatch):
+    """Review regression: a trailing EMPTY group when sum(group_sizes)
+    equals the (padded) row count starts at row m — its work item's row
+    tile must clamp onto the last real tile instead of indexing one past
+    the end (which clobbered tile 0 through the BlockSpec wraparound)."""
+    monkeypatch.setattr(gk, "_INTERPRET", True)
+    rng = np.random.default_rng(3)
+    m, k, n = 256, 16, 16                          # tm=256 -> exactly 1 tile
+    lhs = jnp.asarray(rng.normal(size=(m, k)), jnp.float32)
+    rhs = jnp.asarray(rng.normal(size=(2, k, n)), jnp.float32)
+    gs = jnp.asarray([256, 0], jnp.int32)
+    out = jax.jit(gk.gmm)(lhs, rhs, gs)
+    np.testing.assert_allclose(np.asarray(out), _ref_gmm(lhs, rhs, [256, 0]),
+                               atol=1e-5, rtol=1e-5)
+    # the empty group's tgmm block must be exactly zero, not garbage
+    drhs = jax.grad(lambda r: jnp.sum(gk.gmm(lhs, r, gs) ** 2))(rhs)
+    assert float(jnp.abs(drhs[1]).max()) == 0.0
+
+
+def test_gmm_pallas_interpret_grads(monkeypatch):
+    """custom_vjp: dlhs via gmm(dout, rhs^T), drhs via the tgmm kernel —
+    checked against autodiff through the XLA fallback."""
+    monkeypatch.setattr(gk, "_INTERPRET", True)
+    rng = np.random.default_rng(1)
+    m, k, n, E = 64, 16, 16, 4
+    lhs = jnp.asarray(rng.normal(size=(m, k)), jnp.float32)
+    rhs = jnp.asarray(rng.normal(size=(E, k, n)), jnp.float32)
+    gs = jnp.asarray([16, 0, 32, 8], jnp.int32)    # 8 dropped tail rows
+
+    def loss(lhs, rhs):
+        return jnp.sum(gk.gmm(lhs, rhs, gs) ** 2)
+
+    gl, gr = jax.grad(loss, argnums=(0, 1))(lhs, rhs)
+    monkeypatch.setattr(gk, "_INTERPRET", False)
+
+    def loss_ref(lhs, rhs):
+        return jnp.sum(jnp.asarray(_refable(lhs, rhs, gs)) ** 2)
+
+    def _refable(lhs, rhs, gs):
+        from jax import lax
+        return lax.ragged_dot(lhs, rhs, gs)
+
+    rl, rr = jax.grad(loss_ref, argnums=(0, 1))(lhs, rhs)
+    np.testing.assert_allclose(np.asarray(gl), np.asarray(rl), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gr), np.asarray(rr), atol=1e-4)
+    # dropped tail rows (past sum(group_sizes)) get exactly zero grad
+    assert float(jnp.abs(gl[-8:]).max()) == 0.0
+
+
+def test_gmm_blocked_xla_matches_reference_and_grads():
+    """The block-aligned einsum fallback (what the sorted path uses off-TPU)
+    against the per-segment reference, including blocks past the segments."""
+    rng = np.random.default_rng(2)
+    B, E, k, n = 8, 4, 16, 24
+    sizes = [16, 0, 8, 24]                     # block-aligned (multiples of 8)
+    m = 64                                     # 16 tail rows in no group
+    lhs = jnp.asarray(rng.normal(size=(m, k)), jnp.float32)
+    rhs = jnp.asarray(rng.normal(size=(E, k, n)), jnp.float32)
+    gs = jnp.asarray(sizes, jnp.int32)
+    out = gk.gmm(lhs, rhs, gs, block_aligned=True, block_rows=B)
+    np.testing.assert_allclose(np.asarray(out), _ref_gmm(lhs, rhs, sizes),
+                               atol=1e-5, rtol=1e-5)
+    gl = jax.grad(lambda l: jnp.sum(
+        gk.gmm(l, rhs, gs, block_aligned=True, block_rows=B) ** 2))(lhs)
+    assert float(jnp.abs(gl[48:]).max()) == 0.0    # tail rows: zero grad
+
+
+# ---------------------------------------------------------------------------
+# Token-padding grouping fix (prime/awkward token counts)
+# ---------------------------------------------------------------------------
+def test_group_size_pads_instead_of_collapsing():
+    # old behavior: largest divisor of 1031 <= 512 is 1 -> G=1031 one-token
+    # groups; new behavior honors the request and pads
+    assert moe._group_size(1031, 512) == 512
+    assert moe._group_size(7, 512) == 7        # fewer tokens than a group
+    x = jnp.zeros((1031, 4))
+    xg, pad = moe.group_tokens(x, 512)
+    assert xg.shape == (3, 512, 4) and pad == 3 * 512 - 1031
+
+
+@pytest.mark.parametrize("dispatch", ["sorted", "onehot"])
+def test_moe_mlp_block_prime_token_count_grouping_invariant(dispatch):
+    """Dropless routing is grouping-independent, so the padded 3x64 grouping
+    of a prime token count must reproduce the single-group result exactly —
+    including the aux stats (pad tokens masked out of routing)."""
+    H, I, E = 16, 24, 4
+    key = jax.random.key(3)
+    gate, wg, wu, wd = _weights(key, H, I, E)
+    x = jax.random.normal(jax.random.fold_in(key, 9), (1, 131, H),
+                          jnp.float32)
+    out_pad, aux_pad = moe.moe_mlp_block(
+        x, gate, wg, wu, wd, num_experts_per_tok=2, capacity_factor=None,
+        group_size=64, compute_dtype=jnp.float32, dispatch=dispatch)
+    out_ref, aux_ref = moe.moe_mlp_block(
+        x, gate, wg, wu, wd, num_experts_per_tok=2, capacity_factor=None,
+        group_size=131, compute_dtype=jnp.float32, dispatch=dispatch)
+    np.testing.assert_allclose(np.asarray(out_pad), np.asarray(out_ref),
+                               atol=1e-5, rtol=1e-5)
+    for a, b in zip(aux_pad, aux_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Routing functions: direct units (previously only covered via model tests)
+# ---------------------------------------------------------------------------
+def test_noaux_topk_routing_bias_shifts_selection_only():
+    scores = jnp.asarray([[0.9, 0.8, 0.1, 0.2]], jnp.float32)
+    bias = jnp.asarray([0.0, 0.0, 2.0, 0.0], jnp.float32)
+    w, idx = moe.noaux_topk_routing(scores, bias, 2, norm_topk=False)
+    # expert 2 wins selection through the bias...
+    assert sorted(np.asarray(idx)[0].tolist()) == [0, 2]
+    # ...but combine weights gather the RAW scores (no bias leakage)
+    got = dict(zip(np.asarray(idx)[0].tolist(), np.asarray(w)[0].tolist()))
+    assert got[0] == pytest.approx(0.9) and got[2] == pytest.approx(0.1)
+
+
+def test_noaux_topk_routing_norm_and_scaling():
+    scores = jnp.asarray([[0.5, 0.25, 0.05, 0.2]], jnp.float32)
+    bias = jnp.zeros((4,), jnp.float32)
+    w, idx = moe.noaux_topk_routing(scores, bias, 2, norm_topk=True,
+                                    routed_scaling_factor=2.5)
+    np.testing.assert_allclose(np.asarray(idx)[0], [0, 1])
+    np.testing.assert_allclose(np.asarray(w)[0],
+                               2.5 * np.asarray([0.5, 0.25]) / 0.75,
+                               rtol=1e-5)
+
+
+def test_noaux_topk_routing_group_limited():
+    """n_group=2 over E=4: per-group score = sum of its top-2 biased scores;
+    the losing group is masked to 0.0 and cannot be selected."""
+    scores = jnp.asarray([[0.6, 0.5, 0.9, 0.01]], jnp.float32)
+    bias = jnp.zeros((4,), jnp.float32)
+    # group 0 = {0, 1} score 1.1; group 1 = {2, 3} score 0.91 -> group 0 wins
+    w, idx = moe.noaux_topk_routing(scores, bias, 2, n_group=2, topk_group=1,
+                                    norm_topk=False)
+    assert sorted(np.asarray(idx)[0].tolist()) == [0, 1]
+
+
+def test_softmax_group_topk_greedy_is_plain_topk():
+    scores = jax.nn.softmax(
+        jax.random.normal(jax.random.key(0), (3, 8), jnp.float32))
+    w, idx = moe.softmax_group_topk_routing(scores, 2, topk_method="greedy",
+                                            routed_scaling_factor=3.0)
+    rw, ridx = jax.lax.top_k(scores, 2)
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ridx))
+    # V2 does NOT renormalize: weights are raw scores x scaling factor
+    np.testing.assert_allclose(np.asarray(w), 3.0 * np.asarray(rw),
+                               rtol=1e-6)
+
+
+def test_softmax_group_topk_group_limited_greedy():
+    """Group rank by per-group MAX; only topk_group groups stay eligible."""
+    scores = jnp.asarray([[0.05, 0.4, 0.3, 0.25]], jnp.float32)
+    # n_group=2: group 0 max 0.4, group 1 max 0.3 -> only experts {0, 1}
+    w, idx = moe.softmax_group_topk_routing(
+        scores, 2, topk_method="group_limited_greedy", n_group=2,
+        topk_group=1)
+    assert sorted(np.asarray(idx)[0].tolist()) == [0, 1]
+    with pytest.raises(NotImplementedError):
+        moe.softmax_group_topk_routing(scores, 2, topk_method="noauxtc")
+
+
+# ---------------------------------------------------------------------------
+# Qwen3-MoE router_aux_loss_coef regression (ISSUE 4 satellite)
+# ---------------------------------------------------------------------------
+def _tiny_qwen3(**over):
+    from automodel_tpu.models.qwen3_moe import (
+        Qwen3MoeConfig,
+        Qwen3MoeForCausalLM,
+    )
+
+    cfg = Qwen3MoeConfig(**{**dict(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+        rope_theta=10000.0, tie_word_embeddings=False, num_experts=4,
+        num_experts_per_tok=2, moe_group_size=32,
+        moe_capacity_factor=None), **over})
+    return Qwen3MoeForCausalLM(cfg, param_dtype=jnp.float32,
+                               compute_dtype=jnp.float32, remat=False)
+
+
+def test_qwen3_moe_router_aux_loss_folds_into_training_loss():
+    """HF gating (modeling_qwen3_moe.py): ``coef * load_balancing_loss`` is
+    added to the training loss iff ``output_router_logits`` — and the
+    penalty must scale linearly with the coef (same routing, same stats)."""
+    from automodel_tpu.training.train_step import build_train_step
+    from automodel_tpu.optim import build_optimizer
+
+    ids = np.asarray(
+        jax.random.randint(jax.random.key(0), (1, 2, 24), 0, 128), np.int32)
+    labels = np.roll(ids, -1, -1).copy()
+    labels[..., -1] = -100
+    batch = {"input_ids": ids, "labels": labels}
+    losses, auxes = {}, {}
+    for name, over in (
+            ("off", dict(output_router_logits=False,
+                         router_aux_loss_coef=0.01)),
+            ("on", dict(output_router_logits=True,
+                        router_aux_loss_coef=0.01)),
+            ("on10x", dict(output_router_logits=True,
+                           router_aux_loss_coef=0.1))):
+        model = _tiny_qwen3(**over)
+        params = model.init(jax.random.key(1))   # same key -> same weights
+        out = model(params, jnp.asarray(ids[0]))
+        auxes[name] = float(out["aux_loss"])
+        fns = build_train_step(model, build_optimizer(name="adamw", lr=1e-3))
+        _, _, m = fns.train_step(params, fns.init_opt_state(params),
+                                 jax.device_put(batch,
+                                                fns.microbatch_sharding))
+        losses[name] = float(m["loss"])
+    assert auxes["off"] == 0.0                       # HF: no flag, no penalty
+    assert auxes["on"] > 0.0
+    # linear in the coef (stats identical — same params, same input)
+    np.testing.assert_allclose(auxes["on10x"], 10 * auxes["on"], rtol=1e-5)
+    # the penalty lands in the TRAINING loss, at exactly its reported value
+    np.testing.assert_allclose(losses["on"] - losses["off"], auxes["on"],
+                               atol=1e-6)
+    np.testing.assert_allclose(losses["on10x"] - losses["off"],
+                               auxes["on10x"], atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Config-load enum guard + recipe policy
+# ---------------------------------------------------------------------------
+def test_config_load_validates_moe_dispatch(tmp_path):
+    from automodel_tpu.config.loader import load_yaml_config
+
+    good = tmp_path / "good.yaml"
+    good.write_text("moe:\n  dispatch: onehot\n")
+    assert load_yaml_config(str(good)).get("moe.dispatch") == "onehot"
+    nulled = tmp_path / "nulled.yaml"
+    nulled.write_text("moe:\n  dispatch: null\n")
+    load_yaml_config(str(nulled))                    # null = default, passes
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("moe:\n  dispatch: sroted\n")
+    with pytest.raises(ValueError, match="moe.dispatch"):
+        load_yaml_config(str(bad))
+
+
+def test_model_config_validates_moe_dispatch():
+    from automodel_tpu.models.mixtral import MixtralConfig
+
+    with pytest.raises(ValueError, match="moe.dispatch"):
+        MixtralConfig(moe_dispatch="sroted")
+    assert MixtralConfig(moe_dispatch="none").moe_dispatch is None
+
+
+def test_recipe_policy_rejects_moe_dispatch_on_dense_model():
+    from automodel_tpu.config.loader import ConfigNode
+    from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction as R,
+    )
+
+    from automodel_tpu.recipes.base_recipe import BaseRecipe
+
+    r = object.__new__(R)
+    BaseRecipe.__init__(r)      # just the attribute-tracking plumbing
+    r.cfg = ConfigNode({"moe": {"dispatch": "sorted"}})
+    r.model = LlamaForCausalLM(LlamaConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        rope_theta=10000.0))
+    with pytest.raises(ValueError, match="no routed-expert block"):
+        r._apply_moe_dispatch_policy()
+
+
+# ---------------------------------------------------------------------------
+# Expert-parallel layout audit + full-model parity
+# ---------------------------------------------------------------------------
+def test_sorted_path_layout_audit_under_expert_parallel_mesh():
+    """The sorted path under the dp2xcp2xtp2 mesh with the expert_parallel
+    rules: numerics match the unsharded run, and the token buffer /
+    intermediate constraints are actually emitted (layout audit — a dropped
+    ``constrain`` would silently replicate the buffers)."""
+    from automodel_tpu.distributed.mesh import MeshManager
+    from automodel_tpu.distributed.shardings import (
+        default_rules,
+        sharding_context,
+        spec_for,
+    )
+
+    # the EP rule set the audit rides on: tokens over dp/cp, experts over tp
+    rules = default_rules(expert_parallel=True)
+    assert spec_for(("act_tokens", None), rules)[0] == (
+        "dp_replicate", "dp_shard", "cp")
+    assert spec_for(("experts", "embed", "expert_mlp"), rules)[0] == "tp"
+    assert spec_for(("act_tokens", "expert_mlp"), rules) == \
+        spec_for(("act_tokens", None), rules)   # EP: intermediate unsharded
+
+    G, M, H, I, E, k = 2, 64, 16, 24, 4, 2
+    xg, (w8, idx, _) = _routed(jax.random.key(5), G, M, H, E, k)
+    _, wg, wu, wd = _weights(jax.random.key(6), H, I, E)
+
+    def fn(xg, wg, wu, wd):
+        return moe.sorted_expert_ffn(xg, w8, idx, wg, wu, wd, capacity=M,
+                                     compute_dtype=jnp.float32)
+
+    ref = fn(xg, wg, wu, wd)
+    mm = MeshManager(dp_size=2, cp_size=2, tp_size=2)
+    with sharding_context(mm.mesh, rules):
+        jaxpr = str(jax.make_jaxpr(fn)(xg, wg, wu, wd))
+        # token buffer, silu intermediate, down-proj out, final [G, M, H]
+        assert jaxpr.count("sharding_constraint") >= 4
+        out = jax.jit(fn)(xg, wg, wu, wd)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("family", ["mixtral", "deepseek_v3"])
+def test_full_model_loss_and_grad_parity_sorted_vs_onehot(family):
+    """Acceptance: ``moe.dispatch=sorted`` and ``onehot`` agree on loss and
+    grads to <= 1e-3 through a full model forward/backward (Mixtral softmax
+    routing; DeepSeek-V3 noaux sigmoid routing + shared experts)."""
+    from automodel_tpu.loss.masked_ce import cross_entropy_sum
+
+    def build(dispatch):
+        if family == "mixtral":
+            from automodel_tpu.models.mixtral import (
+                MixtralConfig,
+                MixtralForCausalLM,
+            )
+
+            cfg = MixtralConfig(
+                vocab_size=128, hidden_size=32, intermediate_size=48,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, rope_theta=10000.0,
+                tie_word_embeddings=False, num_local_experts=4,
+                num_experts_per_tok=2, output_router_logits=True,
+                moe_group_size=32, moe_capacity_factor=2.0,
+                moe_dispatch=dispatch)
+            return MixtralForCausalLM(cfg, param_dtype=jnp.float32,
+                                      compute_dtype=jnp.float32, remat=False)
+        from automodel_tpu.models.deepseek_v3 import (
+            DeepseekV3Config,
+            DeepseekV3ForCausalLM,
+        )
+
+        cfg = DeepseekV3Config(
+            vocab_size=128, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, rope_theta=10000.0,
+            tie_word_embeddings=False, q_lora_rank=None, kv_lora_rank=16,
+            qk_rope_head_dim=8, qk_nope_head_dim=8, v_head_dim=8,
+            n_routed_experts=4, num_experts_per_tok=2, n_shared_experts=1,
+            moe_intermediate_size=24, first_k_dense_replace=1,
+            moe_group_size=32, moe_capacity_factor=2.0,
+            moe_dispatch=dispatch)
+        return DeepseekV3ForCausalLM(cfg, param_dtype=jnp.float32,
+                                     compute_dtype=jnp.float32, remat=False)
+
+    ids = np.asarray(
+        jax.random.randint(jax.random.key(2), (2, 24), 0, 128), np.int32)
+    labels = jnp.asarray(np.roll(ids, -1, -1))
+
+    results = {}
+    for dispatch in ("onehot", "sorted"):
+        model = build(dispatch)
+        params = model.init(jax.random.key(0))   # same key -> same weights
+
+        def loss_fn(params):
+            out = model(params, jnp.asarray(ids))
+            loss = cross_entropy_sum(out["logits"], labels) / labels.size
+            return loss + out.get("aux_loss", 0.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        results[dispatch] = (float(loss), grads)
+
+    loss_oh, g_oh = results["onehot"]
+    loss_s, g_s = results["sorted"]
+    assert abs(loss_s - loss_oh) <= 1e-3
+    gmax = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))
+                           / jnp.maximum(jnp.max(jnp.abs(b)), 1.0)),
+        g_s, g_oh)
+    assert max(jax.tree.leaves(gmax)) <= 1e-3
