@@ -21,7 +21,10 @@ from automodel_tpu.checkpoint.checkpointing import (  # noqa: F401
     list_committed_checkpoints,
     prepare_staging,
     read_manifest,
+    record_file_hash,
     retry_io,
+    snapshot_is_host_complete,
+    snapshot_to_host,
     staging_path,
     verify_manifest,
     write_manifest,

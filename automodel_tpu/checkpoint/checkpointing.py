@@ -39,10 +39,12 @@ import pickle
 import random
 import re
 import shutil
+import threading
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import jax
+import numpy as np
 
 from automodel_tpu.utils.fault_injection import fault_point
 
@@ -101,6 +103,14 @@ class CheckpointingConfig:
     # backoff starting at ``io_retry_backoff`` seconds (plus jitter).
     io_retries: int = 3
     io_retry_backoff: float = 0.1
+    # Asynchronous saves (docs/guides/checkpointing.md "Asynchronous
+    # saves"): at a save boundary the training loop only SNAPSHOTS device
+    # state to host buffers, then a single background committer thread runs
+    # the full crash-safe protocol (stage -> write -> vote -> manifest ->
+    # rename -> GC) while training resumes.  ``false`` restores the fully
+    # inline save.  Bool-validated at config load (``config/loader.py``)
+    # like ``distributed.cp_layout``; null means "use the default".
+    async_save: bool = True
 
     def __post_init__(self):
         if isinstance(self.model_save_format, CheckpointFormat):
@@ -117,6 +127,17 @@ class CheckpointingConfig:
                 f"keep_every_n_steps must be >= 1, got {self.keep_every_n_steps}")
         if int(self.io_retries) < 0:
             raise ValueError(f"io_retries must be >= 0, got {self.io_retries}")
+        from automodel_tpu.config.loader import normalize_null_spelling
+
+        # null and its YAML string spellings ("none"/"null"/"") mean "use
+        # the default" — same delegation as cp_layout/moe.dispatch, so the
+        # loader's validation can never bless a value this rejects
+        if normalize_null_spelling(self.async_save) is None:
+            self.async_save = True
+        if not isinstance(self.async_save, bool):
+            raise ValueError(
+                f"checkpoint.async_save must be a bool (or null for the "
+                f"default), got {self.async_save!r}")
 
 
 def build_checkpoint_config(cfg=None, **kwargs) -> CheckpointingConfig:
@@ -157,8 +178,123 @@ def retry_io(fn: Callable, *args, retries: int = 3, backoff: float = 0.1,
 
 
 # ---------------------------------------------------------------------------
+# Host snapshot (async saves)
+# ---------------------------------------------------------------------------
+def _local_shard_coverage(x: jax.Array) -> int:
+    """Number of DISTINCT global-array elements this host's addressable
+    shards cover.  A sharding partitions the array among distinct shard
+    indices (replicas share an index), so coverage == ``x.size`` iff the
+    host can materialize the full array from local data alone."""
+    seen = set()
+    total = 0
+    for shard in x.addressable_shards:
+        key = tuple((s.start, s.stop, s.step) for s in shard.index)
+        if key in seen:
+            continue
+        seen.add(key)
+        total += int(np.prod(shard.data.shape))
+    return total
+
+
+def snapshot_is_host_complete(tree: Any) -> bool:
+    """True iff :func:`snapshot_to_host` can materialize every leaf from
+    THIS host's shards alone — always single-process; on multihost, when
+    each leaf is fully addressable, replicated, or replica-complete on the
+    host (HSDP with the shard axis inside a host).  False means a snapshot
+    would need a cross-host gather of the full tree onto every host — at
+    large scale that is an OOM, so ``BaseRecipe.save_checkpoint`` checks
+    this once and falls back to the inline save instead."""
+    if jax.process_count() == 1:
+        return True
+    for x in jax.tree.leaves(tree):
+        if (isinstance(x, jax.Array) and not x.is_fully_addressable
+                and _local_shard_coverage(x) < x.size):
+            return False
+    return True
+
+
+def snapshot_to_host(tree: Any) -> Any:
+    """Blocking device->host copy of a pytree — the only part of an async
+    save the training loop waits for.
+
+    Fully-addressable leaves ride ONE batched ``jax.device_get`` of the
+    whole tree (parallel transfers; per-leaf fetches serialize a round trip
+    per tensor, which is what makes the inline save path latency-bound on
+    tunneled/remote runtimes).  Non-addressable leaves whose LOCAL shards
+    cover the full array (replicated, or HSDP replica-complete on this
+    host) are assembled from those shards — no cross-host traffic at all.
+    A leaf genuinely sharded ACROSS hosts falls back to
+    ``process_allgather`` — full-tree-per-host memory, which is why
+    recipes probe :func:`snapshot_is_host_complete` first and keep such
+    saves inline.  Everything here runs on the training thread, at the
+    save boundary every host reaches together — the background committer
+    never issues a device collective (a background device op could
+    interleave with training-loop collectives in a different order on
+    different hosts and deadlock the mesh).
+
+    The copy matters even though ``jax.Array`` is immutable: the train step
+    donates params/opt_state buffers, so a reference held across the next
+    dispatch would be a deleted array.
+    """
+    gathered = {}
+    if jax.process_count() > 1:
+        leaves, _ = jax.tree.flatten(tree)
+        for i, x in enumerate(leaves):
+            if not isinstance(x, jax.Array) or x.is_fully_addressable:
+                continue
+            if _local_shard_coverage(x) == x.size:
+                out = np.empty(x.shape, x.dtype)
+                for shard in x.addressable_shards:
+                    out[shard.index] = np.asarray(shard.data)
+                gathered[i] = out
+            else:
+                from jax.experimental import multihost_utils
+
+                gathered[i] = np.asarray(
+                    multihost_utils.process_allgather(x, tiled=True))
+    if gathered:
+        leaves, treedef = jax.tree.flatten(tree)
+        leaves = [gathered.get(i, x) for i, x in enumerate(leaves)]
+        tree = jax.tree.unflatten(treedef, leaves)
+    host = jax.device_get(tree)
+    return jax.tree.map(
+        lambda x: np.asarray(x) if isinstance(x, (jax.Array, np.generic))
+        else x, host)
+
+
+# ---------------------------------------------------------------------------
 # Integrity manifest — written last, the commit marker
 # ---------------------------------------------------------------------------
+# Hashes of host-side files computed WHILE writing them (``save_stateful``
+# pickles the bytes anyway): ``build_manifest`` reuses a hint instead of
+# re-reading the file it just wrote — abspath -> (size, sha256), popped on
+# use.  Size is double-checked so a file modified between write and
+# manifest (or a stale hint) falls back to re-hashing.
+_HASH_HINTS: Dict[str, Tuple[int, str]] = {}
+_hash_hints_lock = threading.Lock()
+
+
+def record_file_hash(path: str, size: int, sha256: str) -> None:
+    with _hash_hints_lock:
+        _HASH_HINTS[os.path.abspath(path)] = (int(size), sha256)
+
+
+def _pop_file_hash(path: str, size: int) -> Optional[str]:
+    with _hash_hints_lock:
+        hint = _HASH_HINTS.pop(os.path.abspath(path), None)
+    if hint is not None and hint[0] == size:
+        return hint[1]
+    return None
+
+
+def _purge_file_hashes(prefix: str) -> None:
+    """Drop hints under a staging dir being cleared (aborted save leftovers)."""
+    prefix = os.path.abspath(prefix) + os.sep
+    with _hash_hints_lock:
+        for key in [k for k in _HASH_HINTS if k.startswith(prefix)]:
+            del _HASH_HINTS[key]
+
+
 def _file_sha256(path: str, chunk: int = 1 << 20) -> str:
     h = hashlib.sha256()
     with open(path, "rb") as f:
@@ -184,7 +320,8 @@ def build_manifest(ckpt_path: str, *, epoch: int, step: int,
             rel = os.path.relpath(full, ckpt_path).replace(os.sep, "/")
             entry: Dict[str, Any] = {"path": rel, "size": os.path.getsize(full)}
             if name.endswith(_CHECKSUM_SUFFIXES):
-                entry["sha256"] = _file_sha256(full)
+                entry["sha256"] = (_pop_file_hash(full, entry["size"])
+                                   or _file_sha256(full))
             files.append(entry)
     from automodel_tpu import __version__ as framework_version
 
@@ -317,8 +454,21 @@ def staging_path(final_path: str) -> str:
     return final_path.rstrip("/") + STAGING_SUFFIX
 
 
+def _sync_fns(coordinator=None):
+    """The (all_hosts_ok, barrier) pair for a save: the module-level
+    device-collective primitives on the training thread (``None``), or a
+    :class:`~automodel_tpu.utils.dist_utils.CollectiveNamespace`'s KV-store
+    routed ones when the protocol runs on the async committer thread."""
+    if coordinator is not None:
+        return coordinator.all_hosts_ok, coordinator.barrier
+    from automodel_tpu.utils.dist_utils import all_hosts_ok, barrier
+
+    return all_hosts_ok, barrier
+
+
 def prepare_staging(final_path: str,
-                    config: Optional[CheckpointingConfig] = None) -> str:
+                    config: Optional[CheckpointingConfig] = None,
+                    coordinator=None) -> str:
     """COLLECTIVE: (re)create the staging dir for ``final_path``.
 
     Process 0 clears any leftover from a previously interrupted save —
@@ -328,10 +478,11 @@ def prepare_staging(final_path: str,
     the sync point, so every host aborts with :class:`CheckpointSaveError`
     in lockstep instead of peers hanging.
     """
-    from automodel_tpu.utils.dist_utils import all_hosts_ok
+    all_hosts_ok, _barrier = _sync_fns(coordinator)
 
     cfg = config or CheckpointingConfig()
     staging = staging_path(final_path)
+    _purge_file_hashes(staging)
     err: Optional[BaseException] = None
     if jax.process_index() == 0:
         try:
@@ -351,7 +502,8 @@ def prepare_staging(final_path: str,
 
 
 def commit_checkpoint(staging: str, final_path: str, *, epoch: int, step: int,
-                      config: Optional[CheckpointingConfig] = None) -> str:
+                      config: Optional[CheckpointingConfig] = None,
+                      coordinator=None) -> str:
     """COLLECTIVE: finalize a fully-written staging dir.
 
     The barrier guarantees every process's collective writes (Orbax,
@@ -363,7 +515,7 @@ def commit_checkpoint(staging: str, final_path: str, *, epoch: int, step: int,
     :class:`CheckpointSaveError` on every host instead of peers hanging at
     a bare barrier.
     """
-    from automodel_tpu.utils.dist_utils import all_hosts_ok, barrier
+    all_hosts_ok, barrier = _sync_fns(coordinator)
 
     cfg = config or CheckpointingConfig()
     barrier("ckpt:all_writes_done")
@@ -501,15 +653,30 @@ def gc_checkpoints(checkpoint_dir: str, *, keep_last_k: Optional[int] = None,
 # ---------------------------------------------------------------------------
 # Orbax helpers
 # ---------------------------------------------------------------------------
-def _checkpointer():
+def _checkpointer(namespace: Optional[str] = None):
     import orbax.checkpoint as ocp
 
-    return ocp.StandardCheckpointer()
+    if namespace is None or jax.process_count() == 1:
+        return ocp.StandardCheckpointer()
+    # Async-committer path on a multi-process run: Orbax's own sync points
+    # default to ``multihost_utils.sync_global_devices`` — a DEVICE
+    # collective that must not be issued from a background thread (enqueue
+    # order vs the training loop differs per host -> deadlock).  Naming the
+    # active process set switches Orbax to its coordination-service barrier
+    # (host-side KV RPC), and the key prefix keeps those barriers in the
+    # committer's namespace.
+    return ocp.StandardCheckpointer(
+        multiprocessing_options=ocp.options.MultiprocessingOptions(
+            active_processes=set(range(jax.process_count())),
+            barrier_sync_key_prefix=namespace))
 
 
-def save_pytree(path: str, tree: Any) -> None:
-    """Sharded pytree save — every process participates (Orbax collective)."""
-    ckptr = _checkpointer()
+def save_pytree(path: str, tree: Any,
+                namespace: Optional[str] = None) -> None:
+    """Sharded pytree save — every process participates (Orbax collective).
+    ``namespace``: route Orbax's internal sync through the coordination
+    service under that key prefix (background/async saves)."""
+    ckptr = _checkpointer(namespace)
     ckptr.save(os.path.abspath(path), tree, force=True)
     ckptr.wait_until_finished()
 
@@ -532,7 +699,12 @@ def abstract_with_shardings(abstract: Any, shardings: Any) -> Any:
 # ---------------------------------------------------------------------------
 def save_model(model, params: Any, weights_path: str,
                config: Optional[CheckpointingConfig] = None,
-               peft_config: Any = None) -> None:
+               peft_config: Any = None, coordinator=None) -> None:
+    """``params`` may be device arrays (inline save) or a host snapshot
+    (async committer — :func:`snapshot_to_host`); the writers treat numpy
+    leaves as already-materialized, so the snapshot is the ONE device->host
+    transfer of an async save.  ``coordinator`` routes the writers' sync
+    points off the device streams (background thread)."""
     config = config or CheckpointingConfig()
     os.makedirs(weights_path, exist_ok=True)
     if config.is_peft or peft_config is not None:
@@ -547,7 +719,9 @@ def save_model(model, params: Any, weights_path: str,
         from automodel_tpu.models.hf_io import copy_hf_aux_files, save_hf_weights
 
         save_hf_weights(model, params, weights_path,
-                        distribute_writes=config.distribute_writes)
+                        distribute_writes=config.distribute_writes,
+                        barrier_fn=(coordinator.barrier
+                                    if coordinator is not None else None))
         retry_io(copy_hf_aux_files, getattr(model, "checkpoint_dir", None),
                  weights_path, retries=config.io_retries,
                  backoff=config.io_retry_backoff, desc="HF aux sidecars")
@@ -555,7 +729,9 @@ def save_model(model, params: Any, weights_path: str,
         # Non-consolidated: Orbax writes each host's own shards — no gather
         # at all (the reference's per-rank DCP sharded save role,
         # ``_backports/hf_storage.py:67``).
-        save_pytree(os.path.join(weights_path, "orbax"), params)
+        save_pytree(os.path.join(weights_path, "orbax"), params,
+                    namespace=(coordinator.name
+                               if coordinator is not None else None))
 
 
 def load_model(model, weights_path: str,
@@ -584,9 +760,14 @@ def load_model(model, weights_path: str,
 
 
 def save_optimizer(opt_state: Any, optim_path: str, scheduler: Any = None,
-                   config: Optional[CheckpointingConfig] = None) -> None:
+                   config: Optional[CheckpointingConfig] = None,
+                   coordinator=None) -> None:
+    """``scheduler`` may be the live object or an already-materialized
+    ``state_dict()`` dict (async snapshot); ``save_stateful`` handles both."""
     os.makedirs(optim_path, exist_ok=True)
-    save_pytree(os.path.join(optim_path, "state"), opt_state)
+    save_pytree(os.path.join(optim_path, "state"), opt_state,
+                namespace=(coordinator.name
+                           if coordinator is not None else None))
     if scheduler is not None and jax.process_index() == 0:
         save_stateful(optim_path, "lr_scheduler", scheduler, config)
 
@@ -605,15 +786,24 @@ def load_optimizer(optim_path: str, abstract_state: Any,
 # ---------------------------------------------------------------------------
 def save_stateful(dirpath: str, key: str, obj: Any,
                   config: Optional[CheckpointingConfig] = None) -> None:
+    """Pickle one host-side stateful (``state_dict()`` of a live object, or
+    a plain dict as-is — the async snapshot path materializes the dicts at
+    the save boundary and passes them here).  The manifest sha256 is
+    computed from the in-memory pickle bytes while they are at hand
+    (``record_file_hash``), so ``build_manifest`` never re-reads the file
+    it just watched being written."""
     sd = obj.state_dict() if hasattr(obj, "state_dict") else obj
     cfg = config or CheckpointingConfig()
+    blob = pickle.dumps(sd)
+    path = os.path.join(dirpath, f"{key}.pt")
 
     def _write():
-        with open(os.path.join(dirpath, f"{key}.pt"), "wb") as f:
-            pickle.dump(sd, f)
+        with open(path, "wb") as f:
+            f.write(blob)
 
     retry_io(_write, retries=cfg.io_retries, backoff=cfg.io_retry_backoff,
              desc=f"stateful {key}")
+    record_file_hash(path, len(blob), hashlib.sha256(blob).hexdigest())
 
 
 def load_stateful(dirpath: str, key: str, obj: Any,

@@ -368,6 +368,13 @@ def _enum_fields():
     }
 
 
+# Bool-valued config fields validated at load time alongside the enums (and
+# re-checked after CLI overrides): a typo'd value must fail naming the field
+# before any recipe state is built from it.  YAML true/false and the CLI's
+# ``translate_value`` both produce real bools; anything else is a typo.
+_BOOL_FIELDS = ("checkpoint.async_save",)
+
+
 def normalize_null_spelling(v: Any) -> Any:
     """YAML null spellings ("none"/"null"/"") mean "use the default" for
     every enum-like config field.  THE single home of that rule —
@@ -393,6 +400,17 @@ def validate_config_enums(cfg: "ConfigNode") -> None:
             raise ValueError(
                 f"config field {dotted!r} must be one of {list(allowed)} "
                 f"(or null for the default), got {v!r}")
+    for dotted in _BOOL_FIELDS:
+        v = cfg.get(dotted, _UNSET)
+        if v is _UNSET:
+            continue
+        v = normalize_null_spelling(v)
+        if v is None:
+            continue
+        if not isinstance(v, bool):
+            raise ValueError(
+                f"config field {dotted!r} must be a bool (or null for the "
+                f"default), got {v!r}")
 
 
 def load_yaml_config(path: str) -> ConfigNode:

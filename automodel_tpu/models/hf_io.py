@@ -809,6 +809,7 @@ def save_hf_weights(
     max_shard_bytes: int = 5 * 1024**3,
     save_dtype: Optional[Any] = None,
     distribute_writes: bool = True,
+    barrier_fn=None,
 ) -> None:
     """Write params as a consolidated HF safetensors repo (+ index + config.json).
 
@@ -820,6 +821,12 @@ def save_hf_weights(
     to the consolidated layout).  Gathers remain collective; process 0 writes
     the index.  ``distribute_writes=False`` restores the host-0-only writer
     (e.g. when only host 0 sees the output filesystem).
+
+    ``barrier_fn``: replaces the internal ``sync_global_devices`` sync
+    points (async-checkpoint committer threads must not issue device
+    collectives; they pass their namespace's KV-store barrier).  Callers in
+    that mode hand in HOST-materialized params (numpy leaves), so the
+    collective-gather branch of ``materialize`` is never reached there.
     """
     from safetensors.numpy import save_file
 
@@ -893,12 +900,15 @@ def save_hf_weights(
     # every writing process creates the dir on ITS filesystem (the output
     # path need not be shared; the index then only covers host-0 files, so
     # non-shared setups should pass distribute_writes=False)
+    if barrier_fn is None:
+        def barrier_fn(tag):
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(tag)
     if proc == 0 or distribute_writes:
         os.makedirs(out_dir, exist_ok=True)
     if nproc > 1:
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices("hf_save_dir_ready")
+        barrier_fn("hf_save_dir_ready")
 
     # Materialize and write one shard at a time: peak host RAM is one shard,
     # not the whole model.  All processes run the loop (the gathers are
@@ -925,9 +935,7 @@ def save_hf_weights(
                       metadata={"format": "pt"})
         del shard
     if nproc > 1:
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices("hf_save_shards_done")
+        barrier_fn("hf_save_shards_done")
     if proc != 0:
         return
     # On a non-shared filesystem, distributed writers leave this host with an

@@ -11,19 +11,42 @@ The model itself is functional (structure + ``self.params`` pytree), so
 unlike the reference there is no nn.Module special-casing: ``save_checkpoint``
 saves ``self.params`` via the checkpoint subsystem and every tracked host
 object via its ``state_dict``.
+
+Asynchronous saves (``checkpoint.async_save``, the default; see
+docs/guides/checkpointing.md "Asynchronous saves"): ``save_checkpoint``
+blocks only for a device->host SNAPSHOT of params/opt state plus the
+host-side state dicts, then a single background committer thread runs the
+entire crash-safe protocol — stage ``.tmp`` -> write -> ``ckpt:
+host_writes_ok`` vote -> manifest -> atomic rename -> retention GC —
+against the snapshot while training continues.  Invariants:
+
+* at most ONE save in flight: a new save, a preemption grace-window save,
+  an end-of-training save, or :meth:`teardown` first JOINS the previous
+  one and surfaces its error (``CheckpointSaveError``);
+* every multihost vote/barrier of a background save runs under the
+  dedicated ``ckpt_async`` collective namespace (KV-store RPCs, never
+  device collectives — ``utils/dist_utils.CollectiveNamespace``), so it
+  cannot interleave with training-loop collectives;
+* a crash mid-background-write still leaves only a ``.tmp`` staging dir
+  that resume ignores — committed-ness remains the final directory name;
+* the snapshot pins the dataloader's last-CONSUMED batch state
+  (``consumed_state_dict``), so an async mid-epoch save resumes
+  stitch-exact under the prefetching input pipeline.
 """
 
 from __future__ import annotations
 
+import contextlib
+import copy
 import logging
 import os
-from typing import Any, Optional
+import threading
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 
 from automodel_tpu.checkpoint import checkpointing as ckpt
 from automodel_tpu.config.loader import ConfigNode, dump_yaml_config
-from automodel_tpu.utils.dist_utils import all_hosts_ok
 from automodel_tpu.utils.fault_injection import fault_point
 
 logger = logging.getLogger(__name__)
@@ -35,9 +58,33 @@ def has_load_restore_state(obj: Any) -> bool:
     return hasattr(obj, "state_dict") and hasattr(obj, "load_state_dict")
 
 
+class _SaveJob:
+    """Everything one save needs, captured at the save boundary.
+
+    The inline (sync) path carries LIVE objects — state dicts are read at
+    write time, exactly the pre-async behavior.  The async path carries a
+    HOST SNAPSHOT: numpy params/opt trees and materialized (deep-copied)
+    state dicts, so the background committer never touches live training
+    state and a batch consumed after the boundary cannot leak in.
+    """
+
+    def __init__(self, *, epoch: int, step: int, final: str, cfg,
+                 model=None, params=None, opt_state=None, scheduler=None,
+                 peft_config=None, host_state=(), resumed_from=None,
+                 coordinator=None, is_async: bool = False):
+        self.epoch, self.step, self.final, self.cfg = epoch, step, final, cfg
+        self.model, self.params, self.opt_state = model, params, opt_state
+        self.scheduler, self.peft_config = scheduler, peft_config
+        self.host_state: List[Tuple[str, Any]] = list(host_state)
+        self.resumed_from = resumed_from
+        self.coordinator = coordinator
+        self.is_async = is_async
+
+
 class BaseRecipe:
     def __init__(self):
         object.__setattr__(self, "_state_tracked", {})
+        object.__setattr__(self, "_inflight_save", None)
 
     def __setattr__(self, key: str, value: Any) -> None:
         if not key.startswith("_") and not any(
@@ -46,7 +93,31 @@ class BaseRecipe:
                 self._state_tracked[key] = value
         object.__setattr__(self, key, value)
 
-    # -- save --------------------------------------------------------------
+    # -- shared setup hooks --------------------------------------------------
+    def _setup_compile_cache(self, cfg: Optional[ConfigNode]) -> None:
+        """Wire the persistent XLA compile cache from the ``compile:`` YAML
+        section (the torch.compile-config analogue;
+        ``utils/compile_utils.py``).  First-compile of a 1B train step is
+        20-40s per process; with a shared cache dir the second run loads it
+        in under a second — the first dispatch's wall time is logged by the
+        recipes so cache hits are visible in the run log."""
+        if cfg is None or cfg.get("compile") is None:
+            return
+        from automodel_tpu.utils.compile_utils import (
+            apply_compile_config,
+            build_compile_config,
+        )
+
+        apply_compile_config(build_compile_config(cfg.get("compile")))
+
+    # -- timers (optional: _TinyRecipe-style harnesses have none) ------------
+    def _record_timer(self, name: str):
+        timers = getattr(self, "timers", None)
+        if timers is None:
+            return contextlib.nullcontext()
+        return timers.record(name)
+
+    # -- save ----------------------------------------------------------------
     def save_checkpoint(self, epoch: int, step: int) -> str:
         """Crash-safe save: stage -> write -> barrier -> manifest -> rename.
 
@@ -58,16 +129,212 @@ class BaseRecipe:
         and the next save at the same step clears.  After a successful
         commit, retention GC prunes superseded checkpoints per
         ``keep_last_k``/``keep_every_n_steps`` (never the resume source).
+
+        With ``checkpoint.async_save`` (default) only the device->host
+        snapshot happens here — the protocol above runs on the background
+        committer and this returns the final path the commit will land at;
+        a commit failure surfaces at the next join point (next save, the
+        preemption save, :meth:`teardown`, or end of training).  The time
+        this method blocks the loop is recorded as the ``ckpt_stall``
+        timer; the committer's wall time as ``ckpt_background``.
         """
         cfg: ckpt.CheckpointingConfig = getattr(
             self, "checkpoint_config", None) or ckpt.CheckpointingConfig()
         if not cfg.enabled:
             return ""
-        final = os.path.join(
-            cfg.checkpoint_dir, ckpt.checkpoint_dir_name(epoch, step))
+        with self._record_timer("ckpt_stall"):
+            # at most one save in flight: joining here also surfaces a
+            # previous background commit's failure before new state is risked
+            self.join_pending_save()
+            fault_point("ckpt_pre_save")
+            final = os.path.join(
+                cfg.checkpoint_dir, ckpt.checkpoint_dir_name(epoch, step))
+            if not cfg.async_save or not self._async_snapshot_feasible():
+                job = self._build_live_save_job(epoch, step, final, cfg)
+                return self._run_commit_protocol(job)
+            fault_point("ckpt_async_snapshot")
+            job = self._build_snapshot_save_job(epoch, step, final, cfg)
+            holder = {"final": final, "error": None}
+            thread = threading.Thread(
+                target=self._commit_in_background, args=(job, holder),
+                name="automodel-ckpt-committer", daemon=False)
+            holder["thread"] = thread
+            object.__setattr__(self, "_inflight_save", holder)
+            thread.start()
+        logger.info(
+            "Checkpoint %s dispatched to the background committer "
+            "(snapshot taken; training resumes)", final)
+        return final
+
+    def _async_snapshot_feasible(self) -> bool:
+        """Async saves snapshot the FULL params/opt state into host memory.
+        Single-process, replicated, and HSDP replica-complete shardings can
+        do that from local shards; state genuinely sharded ACROSS hosts
+        (multi-host FSDP) would need a full-tree gather onto every host —
+        an OOM at exactly the scales async saves target, and it would also
+        defeat the per-host-shard Orbax write.  Such runs keep the inline
+        save (pre-async behavior, warned once).  Shardings never change
+        between saves, so the probe result is cached.
+
+        The local probe is VOTED across hosts: shard coverage is a
+        per-host property (an HSDP replica axis may land inside one host
+        but straddle another), and a host that went async would wait on
+        KV-store barriers while an inline host waits on device
+        collectives — primitives that can never match.  All hosts reach
+        this probe together (same save boundary, same config), so the
+        vote is a safe training-thread collective."""
+        ok = getattr(self, "_async_snapshot_ok", None)
+        if ok is None:
+            from automodel_tpu.utils.dist_utils import all_hosts_ok
+
+            ok = all_hosts_ok(
+                ckpt.snapshot_is_host_complete(getattr(self, "params", None))
+                and ckpt.snapshot_is_host_complete(
+                    getattr(self, "opt_state", None)),
+                "ckpt:async_feasible")
+            if not ok:
+                logger.warning(
+                    "checkpoint.async_save disabled for this run: params/"
+                    "optimizer state is sharded across hosts, so a host "
+                    "snapshot would gather the full tree onto every host; "
+                    "saves stay inline (crash-safe protocol unchanged)")
+            object.__setattr__(self, "_async_snapshot_ok", ok)
+        return ok
+
+    def _ckpt_coordinator(self):
+        """The dedicated collective namespace for background commits —
+        lazily built once per recipe so its barrier sequence numbers stay
+        aligned across hosts (every host runs the same save sequence)."""
+        coord = getattr(self, "_ckpt_coord", None)
+        if coord is None:
+            from automodel_tpu.utils.dist_utils import CollectiveNamespace
+
+            coord = CollectiveNamespace("ckpt_async")
+            object.__setattr__(self, "_ckpt_coord", coord)
+        return coord
+
+    def _tracked_host_state(self) -> List[Tuple[str, Any]]:
+        return [(key, obj) for key, obj in self._state_tracked.items()
+                if key not in ("lr_scheduler",)]  # saved with the optimizer
+
+    def _build_live_save_job(self, epoch, step, final, cfg) -> _SaveJob:
+        return _SaveJob(
+            epoch=epoch, step=step, final=final, cfg=cfg,
+            model=getattr(self, "model", None),
+            params=getattr(self, "params", None),
+            opt_state=getattr(self, "opt_state", None),
+            scheduler=getattr(self, "lr_scheduler", None),
+            peft_config=getattr(self, "peft_config", None),
+            host_state=self._tracked_host_state(),
+            resumed_from=getattr(self, "_resumed_from", None))
+
+    def _build_snapshot_save_job(self, epoch, step, final, cfg) -> _SaveJob:
+        """The blocking half of an async save: one batched device->host
+        fetch of params/opt state (cross-host-sharded leaves consolidated
+        here, on the training thread — the committer must never run a
+        device collective) plus deep copies of every host-side state dict.
+        The dataloader contributes its last-CONSUMED-batch snapshot
+        (``consumed_state_dict``), pinning async resume to exactly the
+        batches trained on — queued/staged prefetch lookahead is invisible
+        to the committer by construction."""
+        params = getattr(self, "params", None)
+        opt_state = getattr(self, "opt_state", None)
+        scheduler = getattr(self, "lr_scheduler", None)
+        host_state: List[Tuple[str, Any]] = []
+        for key, obj in self._tracked_host_state():
+            if isinstance(obj, ConfigNode):
+                host_state.append((key, copy.deepcopy(obj)))
+            elif hasattr(obj, "consumed_state_dict"):
+                host_state.append(
+                    (key, copy.deepcopy(obj.consumed_state_dict())))
+            elif hasattr(obj, "state_dict"):
+                host_state.append((key, copy.deepcopy(obj.state_dict())))
+            else:
+                host_state.append((key, copy.deepcopy(obj)))
+        # ONE snapshot call for both trees: the batched device->host fetch
+        # pays its round-trip latency once, not once per tree
+        snap = ckpt.snapshot_to_host({"params": params, "opt": opt_state})
+        return _SaveJob(
+            epoch=epoch, step=step, final=final, cfg=cfg,
+            model=getattr(self, "model", None),
+            params=snap["params"],
+            opt_state=snap["opt"],
+            scheduler=(None if scheduler is None
+                       else copy.deepcopy(scheduler.state_dict())),
+            peft_config=getattr(self, "peft_config", None),
+            host_state=host_state,
+            resumed_from=getattr(self, "_resumed_from", None),
+            coordinator=self._ckpt_coordinator(), is_async=True)
+
+    def _commit_in_background(self, job: _SaveJob, holder: Dict) -> None:
+        try:
+            with self._record_timer("ckpt_background"):
+                self._run_commit_protocol(job)
+        except BaseException as e:  # surfaced at the next join point
+            holder["error"] = e
+            logger.exception(
+                "background checkpoint commit of %s failed", job.final)
+
+    def join_pending_save(self, raise_error: bool = True) -> Optional[str]:
+        """Wait for the in-flight background save, if any; its final path.
+
+        A commit failure re-raises here as :class:`~automodel_tpu.
+        checkpoint.checkpointing.CheckpointSaveError` (original failure
+        chained) — the async path's error surface.  ``raise_error=False``
+        logs instead (teardown while another exception is already
+        propagating must not mask it)."""
+        holder = getattr(self, "_inflight_save", None)
+        if holder is None:
+            return None
+        holder["thread"].join()
+        object.__setattr__(self, "_inflight_save", None)
+        err = holder.get("error")
+        if err is None:
+            return holder["final"]
+        if not raise_error:
+            logger.error(
+                "suppressing background checkpoint failure of %s during "
+                "teardown: %s", holder["final"], err)
+            return None
+        if isinstance(err, ckpt.CheckpointSaveError):
+            raise err
+        raise ckpt.CheckpointSaveError(
+            f"asynchronous checkpoint commit of {holder['final']} failed "
+            "in the background committer") from err
+
+    def teardown(self, raise_error: bool = True) -> None:
+        """Join-on-teardown: the background committer (non-daemon) must have
+        exited — commit landed or error surfaced — before the recipe is
+        released; also unwinds the input pipeline's producer thread."""
+        self.join_pending_save(raise_error=raise_error)
+        loader = getattr(self, "dataloader", None)
+        if loader is not None and hasattr(loader, "close"):
+            loader.close()
+
+    def _run_commit_protocol(self, job: _SaveJob) -> str:
+        """The crash-safe commit protocol, shared verbatim by the inline
+        path (training thread, device collectives) and the background
+        committer (host snapshot, ``ckpt_async`` KV-namespace collectives —
+        ``job.coordinator``)."""
+        path = ckpt.prepare_staging(  # collective
+            job.final, job.cfg, coordinator=job.coordinator)
+        if job.is_async:
+            # Armed under AUTOMODEL_FAULT=ckpt_async_commit (tests): a
+            # failure at the start of the background write — staging
+            # exists, nothing committed; surfaces at the next join point.
+            fault_point("ckpt_async_commit")
+        try:
+            return self._commit_into_staging(job, path)
+        except BaseException:
+            # any abort leaves staging for inspection but must drop the
+            # manifest hash hints recorded for it (pop-on-use never ran);
+            # a retry at the same step re-records its own
+            ckpt._purge_file_hashes(path)
+            raise
+
+    def _commit_into_staging(self, job: _SaveJob, path: str) -> str:
+        cfg, final, coord = job.cfg, job.final, job.coordinator
         is_main = jax.process_index() == 0
-        fault_point("ckpt_pre_save")
-        path = ckpt.prepare_staging(final, cfg)  # collective
 
         # COLLECTIVE writers (model weights, optimizer) under the same
         # try/vote discipline as the host-side writes below: an exception
@@ -80,18 +347,17 @@ class BaseRecipe:
         host_err = None
         try:
             fault_point("ckpt_collective_save")
-            # model weights (collective)
-            if getattr(self, "params", None) is not None:
-                ckpt.save_model(self.model, self.params,
+            # model weights (collective; host-snapshot numpy under async)
+            if job.params is not None:
+                ckpt.save_model(job.model, job.params,
                                 os.path.join(path, "model"), cfg,
-                                peft_config=getattr(self, "peft_config",
-                                                    None))
+                                peft_config=job.peft_config,
+                                coordinator=coord)
             # optimizer + LR scheduler (collective)
-            if getattr(self, "opt_state", None) is not None:
+            if job.opt_state is not None:
                 ckpt.save_optimizer(
-                    self.opt_state, os.path.join(path, "optim"),
-                    scheduler=getattr(self, "lr_scheduler", None),
-                    config=cfg)
+                    job.opt_state, os.path.join(path, "optim"),
+                    scheduler=job.scheduler, config=cfg, coordinator=coord)
         except Exception as e:
             host_err = e
             logger.exception(
@@ -103,9 +369,7 @@ class BaseRecipe:
         # pool.  All hosts abort (or commit) in lockstep.
         if is_main and host_err is None:
             try:
-                for key, obj in self._state_tracked.items():
-                    if key in ("lr_scheduler",):
-                        continue  # saved with the optimizer
+                for key, obj in job.host_state:
                     if isinstance(obj, ConfigNode):
                         ckpt.retry_io(
                             dump_yaml_config, obj,
@@ -119,8 +383,9 @@ class BaseRecipe:
                         # requests the last-CONSUMED-batch snapshot when an
                         # object distinguishes the two (datasets/prefetch
                         # .py) — resume then replays nothing and skips
-                        # nothing.  save_stateful pickles a plain dict
-                        # as-is.
+                        # nothing.  Snapshot jobs already hold plain dicts
+                        # (materialized at the save boundary); save_stateful
+                        # pickles those as-is.
                         if hasattr(obj, "consumed_state_dict"):
                             obj = obj.consumed_state_dict()
                         ckpt.save_stateful(path, key, obj, cfg)
@@ -129,6 +394,7 @@ class BaseRecipe:
                 logger.exception(
                     "host-side checkpoint writes failed for %s", final)
         fault_point("ckpt_pre_commit")
+        all_hosts_ok, _ = ckpt._sync_fns(coord)
         if not all_hosts_ok(host_err is None, "ckpt:host_writes_ok"):
             note = f"; staging left at {path} for inspection"
             if host_err is not None:
@@ -138,26 +404,31 @@ class BaseRecipe:
             raise ckpt.CheckpointSaveError(
                 f"aborting commit of {final}: a peer host failed its "
                 f"writes{note}")
-        ckpt.commit_checkpoint(path, final, epoch=epoch, step=step, config=cfg)
+        ckpt.commit_checkpoint(path, final, epoch=job.epoch, step=job.step,
+                               config=cfg, coordinator=coord)
         fault_point("ckpt_post_commit")
         if is_main:
             deleted = ckpt.gc_checkpoints(
                 cfg.checkpoint_dir, keep_last_k=cfg.keep_last_k,
                 keep_every_n_steps=cfg.keep_every_n_steps,
-                protect=(getattr(self, "_resumed_from", None),), config=cfg)
+                protect=(job.resumed_from,), config=cfg)
             if deleted:
                 logger.info("Checkpoint GC removed %d superseded dir(s): %s",
                             len(deleted),
                             ", ".join(os.path.basename(d) for d in deleted))
-        logger.info("Committed checkpoint %s", final)
+        logger.info("Committed checkpoint %s%s", final,
+                    " (background)" if job.is_async else "")
         return final
 
-    # -- load --------------------------------------------------------------
+    # -- load ----------------------------------------------------------------
     def load_checkpoint(self, restore_from: Optional[str] = None) -> Optional[str]:
         """Resume from ``restore_from`` (explicit) or the newest committed
         checkpoint.  The manifest is verified BEFORE any state is touched,
         so a corrupt/uncommitted dir fails with an error naming it instead
         of a half-restored recipe; discovery already skips such dirs."""
+        # an in-flight background save must land (or surface its failure)
+        # before resume scans the checkpoint root
+        self.join_pending_save()
         cfg: ckpt.CheckpointingConfig = getattr(
             self, "checkpoint_config", None) or ckpt.CheckpointingConfig()
         restore_from = restore_from or cfg.restore_from
@@ -177,6 +448,8 @@ class BaseRecipe:
         # everyone still checks existence + sizes.  The verdict is VOTED so
         # a checksum failure seen only by process 0 aborts every host in
         # lockstep rather than stranding peers in the collective restore.
+        from automodel_tpu.utils.dist_utils import all_hosts_ok
+
         verr = None
         try:
             ckpt.verify_manifest(path, deep=jax.process_index() == 0)

@@ -219,14 +219,10 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             **(cfg.get("dist_env").to_dict()
                if cfg.get("dist_env") is not None else {}))
 
-        # Persistent XLA compile cache (the torch.compile-config analogue)
-        if cfg.get("compile") is not None:
-            from automodel_tpu.utils.compile_utils import (
-                apply_compile_config,
-                build_compile_config,
-            )
-
-            apply_compile_config(build_compile_config(cfg.get("compile")))
+        # Persistent XLA compile cache (the torch.compile-config analogue;
+        # BaseRecipe hook shared with the VLM recipe).  The first train-step
+        # dispatch logs its wall time so cache hits are visible.
+        self._setup_compile_cache(cfg)
 
         # RNG
         rng_cfg = cfg.get("rng")
@@ -640,6 +636,21 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             with self.timers.record("dispatch"):
                 self.params, self.opt_state, metrics = self.step_fns.train_step(
                     self.params, self.opt_state, batch)
+        if not getattr(self, "_first_dispatch_logged", False):
+            # The first dispatch traces + XLA-compiles before returning;
+            # later dispatches are sub-ms enqueues.  Logging the wall time
+            # makes persistent-compile-cache hits visible: with a warm
+            # ``compile.cache_dir`` this drops from tens of seconds to
+            # under one (utils/compile_utils.py).
+            self._first_dispatch_logged = True
+            cache_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
+            logger.info(
+                "first train-step dispatch took %.2fs (includes XLA "
+                "compile; persistent compile cache %s)",
+                time.perf_counter() - t0,
+                f"at {cache_dir}" if cache_dir else
+                "off — set compile.cache_dir to reuse compilations "
+                "across runs")
         if dl_state is not None and hasattr(self.dataloader, "commit_state"):
             # this group is now consumed: a checkpoint from here on resumes
             # at the batch AFTER it
@@ -799,8 +810,21 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         )
 
         self.preempted = False
-        with DistributedSignalHandler() as preempt:
-            self._train_epochs(sched, is_main, prof, preempt)
+        # anchor the first profiling window at loop start — without it the
+        # first interval's window is zero-length and ckpt_stall_fraction
+        # reports 0 even when a save stalled inside it
+        self._prof_window_t0 = time.perf_counter()
+        try:
+            with DistributedSignalHandler() as preempt:
+                self._train_epochs(sched, is_main, prof, preempt)
+        except BaseException:
+            # teardown must not mask the propagating failure with a
+            # background-save error — log it instead
+            self.teardown(raise_error=False)
+            raise
+        # join-on-teardown: the final (possibly end-of-training) async save
+        # lands — or surfaces its error — before the loop returns
+        self.teardown()
         if self.preempted and is_main:
             logger.warning(
                 "preemption (%s) handled at step %d: %s, exiting cleanly",
@@ -915,15 +939,31 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             # per-step ms over the window; host-local, logged on main
             elapsed = self.timers.get_elapsed(
                 reset=True, normalizer=prof.log_interval)
+            now = time.perf_counter()
+            window = now - getattr(self, "_prof_window_t0", now)
+            self._prof_window_t0 = now
             if is_main and elapsed:
+                from automodel_tpu.training.timers import ckpt_stall_fraction
+
+                # fraction of the window the loop spent BLOCKED on
+                # checkpointing (snapshot/join under async_save, the whole
+                # save inline) — the metric the async save path exists to
+                # drive toward 0; elapsed is per-step, so un-normalize
+                frac = ckpt_stall_fraction(
+                    {"ckpt_stall":
+                     elapsed.get("ckpt_stall", 0.0) * prof.log_interval},
+                    window)
                 logger.info(
-                    "step %d | time (ms)%s", step,
+                    "step %d | time (ms)%s%s", step,
                     "".join(f" | {n}: {v * 1e3:.2f}"
-                            for n, v in elapsed.items()))
+                            for n, v in elapsed.items()),
+                    (f" | ckpt_stall_fraction: {frac:.4f}"
+                     if "ckpt_stall" in elapsed else ""))
                 if self.wandb is not None:
-                    self.wandb.log(
-                        {f"timers/{n}": v for n, v in elapsed.items()},
-                        step=step)
+                    log = {f"timers/{n}": v for n, v in elapsed.items()}
+                    if "ckpt_stall" in elapsed:
+                        log["timers/ckpt_stall_fraction"] = frac
+                    self.wandb.log(log, step=step)
         if is_val:
             self.flush_metrics()
             val_loss = self._run_validation_epoch()
@@ -933,7 +973,11 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                     self.wandb.log({"val_loss": val_loss}, step=step)
         if is_ckpt and self.checkpoint_config.enabled:
             # Drain the in-flight step first so its NaN guard runs
-            # before the params it produced are persisted.
+            # before the params it produced are persisted.  Under
+            # checkpoint.async_save this blocks only for the host
+            # snapshot (timed as ckpt_stall); the commit overlaps the
+            # following steps and any failure surfaces at the next join
+            # point (next save, preemption save, or end of training).
             self.flush_metrics()
             self.save_checkpoint(epoch, step)
             self._last_ckpt_step = step
@@ -959,9 +1003,13 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 # preemptor's hard kill — acceptable here because
                 # the whole pool is being torn down regardless; the
                 # point of the catch is the state guarantee, not
-                # saving the doomed processes.
+                # saving the doomed processes.  An async save must
+                # BLOCK here until committed (join) — dispatching
+                # into a background thread the preemptor is about to
+                # kill would guarantee a torn .tmp every preemption.
                 try:
                     self.save_checkpoint(epoch, step)
+                    self.join_pending_save()
                     self._last_ckpt_step = step
                     saved = True
                 except Exception:
@@ -969,6 +1017,20 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                         "preemption checkpoint at step %d failed; "
                         "resume will use the last committed "
                         "checkpoint", step)
+            else:
+                # a routine async save may still be in flight from an
+                # earlier boundary: land it inside the grace window too
+                try:
+                    self.join_pending_save()
+                except Exception:
+                    # that in-flight save was the one _last_ckpt_step
+                    # recorded at dispatch — it never committed, so it
+                    # must not count as "saved at this step" below
+                    self._last_ckpt_step = -1
+                    logger.exception(
+                        "in-flight background checkpoint failed during "
+                        "preemption handling; resume will use the last "
+                        "committed checkpoint")
             self._preempt_saved = (
                 saved or getattr(self, "_last_ckpt_step", -1) == step)
             self.preempted = True

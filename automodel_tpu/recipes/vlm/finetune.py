@@ -14,8 +14,13 @@ batches simply carry ``pixel_values`` which the step shards over dp.
 
 Checkpointing (the full ``checkpoint:`` YAML surface — atomic commit,
 ``restore_from``, ``keep_last_k``/``keep_every_n_steps`` retention,
-``io_retries``) is inherited unchanged from ``BaseRecipe`` via the LLM
-recipe; see ``docs/guides/checkpointing.md``.
+``io_retries``, and the asynchronous snapshot-to-host save path behind
+``checkpoint.async_save``) is inherited unchanged from ``BaseRecipe`` via
+the LLM recipe — the hot loop's save boundaries, join points (next save /
+preemption grace window / teardown) and ``ckpt_stall`` accounting are the
+LLM recipe's; see ``docs/guides/checkpointing.md``.  Async saves matter
+most here: VLM checkpoints carry the vision tower + decoder, so the inline
+write stall they replace is the longest in the repo.
 """
 
 from __future__ import annotations

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -40,6 +41,26 @@ def input_idle_fraction(elapsed: Dict[str, float], window: float) -> float:
         return 0.0
     idle = sum(elapsed.get(name, 0.0) for name in INPUT_TIMERS)
     return min(idle / window, 1.0)
+
+
+# Checkpoint-path timers (recipes/base_recipe.py): ``ckpt_stall`` is the
+# time the TRAINING LOOP was blocked by a save — under ``checkpoint.
+# async_save`` just the device->host snapshot plus any join on a previous
+# in-flight commit; inline (sync) saves charge the whole protocol here.
+# ``ckpt_background`` is the committer thread's wall time for the staged
+# write/vote/manifest/rename/GC protocol — it overlaps training, so it is
+# NOT loop stall (the two timers are recorded from different threads).
+CKPT_TIMERS = ("ckpt_stall", "ckpt_background")
+
+
+def ckpt_stall_fraction(elapsed: Dict[str, float], window: float) -> float:
+    """Fraction of a wall-clock window the loop spent blocked on
+    checkpointing — the number asynchronous saves exist to drive toward 0
+    (logged each profiling interval; bench.py's ``ckpt_stall_ms`` secondary
+    measures the per-save absolute under both modes)."""
+    if window <= 0:
+        return 0.0
+    return min(elapsed.get("ckpt_stall", 0.0) / window, 1.0)
 
 
 @dataclasses.dataclass
@@ -79,53 +100,67 @@ def build_profiling_config(cfg) -> ProfilingConfig:
 
 
 class _Timer:
+    # The accumulator state is lock-guarded: the async-checkpoint committer
+    # records ``ckpt_background`` from its own thread while the training
+    # loop's profiling interval reads/resets the same Timers instance —
+    # unguarded, elapsed() can see stop() clear _start between its check
+    # and its subtraction (TypeError), and a concurrent += vs = 0.0 loses
+    # or double-counts the commit time.
+
     def __init__(self, name: str):
         self.name = name
         self._start: Optional[float] = None
         self._elapsed = 0.0
         self._history: List[float] = []
+        self._lock = threading.Lock()
 
     def start(self, barrier: bool = False) -> None:
-        assert self._start is None, f"timer {self.name} already started"
         if barrier:
             _device_barrier()
-        self._start = time.perf_counter()
+        with self._lock:
+            assert self._start is None, f"timer {self.name} already started"
+            self._start = time.perf_counter()
 
     def stop(self, barrier: bool = False) -> None:
-        assert self._start is not None, f"timer {self.name} not started"
         if barrier:
             _device_barrier()
-        dt = time.perf_counter() - self._start
-        self._elapsed += dt
-        self._history.append(dt)
-        self._start = None
+        with self._lock:
+            assert self._start is not None, f"timer {self.name} not started"
+            dt = time.perf_counter() - self._start
+            self._elapsed += dt
+            self._history.append(dt)
+            self._start = None
 
     def elapsed(self, reset: bool = True) -> float:
         # A running timer is read without stopping: the partial interval is
         # included but NOT recorded in _history (mean() stays per-full-stop).
         # On reset the running span is re-based to now so the partial
         # interval is not reported twice.
-        out = self._elapsed
-        now = time.perf_counter()
-        if self._start is not None:
-            out += now - self._start
+        with self._lock:
+            out = self._elapsed
+            now = time.perf_counter()
+            if self._start is not None:
+                out += now - self._start
+                if reset:
+                    self._start = now
             if reset:
-                self._start = now
-        if reset:
-            self._elapsed = 0.0
-        return out
+                self._elapsed = 0.0
+            return out
 
     def mean(self) -> float:
-        return float(np.mean(self._history)) if self._history else 0.0
+        with self._lock:
+            return float(np.mean(self._history)) if self._history else 0.0
 
     def discard(self) -> None:
         """Abandon a running interval without recording it (e.g. a data-wait
         that ended in StopIteration)."""
-        self._start = None
+        with self._lock:
+            self._start = None
 
     def reset(self) -> None:
-        self._elapsed = 0.0
-        self._history.clear()
+        with self._lock:
+            self._elapsed = 0.0
+            self._history.clear()
 
 
 def _device_barrier() -> None:
@@ -143,13 +178,17 @@ class Timers:
         self.log_option = log_option
         self._timers: Dict[str, _Timer] = {}
         self._log_levels: Dict[str, int] = {}
+        # registry lock: the async-checkpoint committer creates/records its
+        # timer from a background thread while the loop iterates the dict
+        self._registry_lock = threading.Lock()
 
     def __call__(self, name: str, log_level: Optional[int] = None) -> _Timer:
-        if name not in self._timers:
-            self._timers[name] = _Timer(name)
-            self._log_levels[name] = (
-                log_level if log_level is not None else self.log_level)
-        return self._timers[name]
+        with self._registry_lock:
+            if name not in self._timers:
+                self._timers[name] = _Timer(name)
+                self._log_levels[name] = (
+                    log_level if log_level is not None else self.log_level)
+            return self._timers[name]
 
     @contextlib.contextmanager
     def record(self, name: str, barrier: bool = False):
@@ -162,11 +201,12 @@ class Timers:
 
     def get_elapsed(self, names: Optional[List[str]] = None,
                     reset: bool = True, normalizer: float = 1.0) -> Dict[str, float]:
-        names = names if names is not None else list(self._timers)
-        return {
-            n: self._timers[n].elapsed(reset=reset) / normalizer
-            for n in names if n in self._timers
-        }
+        with self._registry_lock:
+            if names is None:
+                names = list(self._timers)
+            timers = [(n, self._timers[n]) for n in names
+                      if n in self._timers]
+        return {n: t.elapsed(reset=reset) / normalizer for n, t in timers}
 
     def get_global_elapsed(self, names: List[str],
                            reset: bool = True, normalizer: float = 1.0

@@ -11,8 +11,13 @@ What remains host-side is execution ordering: ``FirstRankPerNode``-style
 from __future__ import annotations
 
 import contextlib
+import itertools
+import logging
+import threading
 
 import jax
+
+logger = logging.getLogger(__name__)
 
 
 def barrier(tag: str) -> None:
@@ -46,6 +51,109 @@ def all_hosts_ok(ok: bool, tag: str = "all_hosts_ok") -> bool:
             tag, np.nonzero(~flags.reshape(-1))[0].tolist())
         return False
     return True
+
+
+class CollectiveNamespace:
+    """Host-coordination primitives for a BACKGROUND domain (the async
+    checkpoint committer), isolated from the training loop's collectives.
+
+    :func:`barrier` and :func:`all_hosts_ok` above run tiny DEVICE
+    computations (``sync_global_devices`` / ``process_allgather``).  That is
+    correct on the training thread, where every host enqueues device work in
+    the same order — but a background thread using them would race the
+    training loop for enqueue order: host A could enqueue [train_step,
+    barrier] while host B enqueues [barrier, train_step], and cross-host
+    device collectives deadlock on such an order mismatch.  This class
+    provides the same two primitives routed through the ``jax.distributed``
+    coordination service's KEY-VALUE store instead — pure host-side RPCs
+    that never touch a device stream, so they cannot interleave with
+    training-loop collectives no matter when the background thread runs.
+
+    Keys are namespaced (``<name>/<seq>/<tag>``) with a per-instance
+    sequence counter, so repeated saves reuse tags without colliding (KV
+    barriers are single-use) — every host must therefore drive its instance
+    through the SAME sequence of calls, which the checkpoint protocol
+    guarantees (saves happen at deterministic step boundaries).
+
+    Single-process: every call is a local no-op, like the module functions.
+    Multi-process without a coordination client (never the case after
+    ``jax.distributed.initialize``): falls back to the device-collective
+    primitives with the namespaced tag — correct only while the training
+    loop is quiescent, so it logs a warning once.
+    """
+
+    # Generous ceiling: a vote may legitimately wait out a peer's multi-GB
+    # checkpoint write; past this, the save surfaces as failed at the next
+    # join point rather than hanging the committer forever.
+    timeout_ms = 1800 * 1000
+
+    def __init__(self, name: str):
+        self.name = name
+        self._seq = itertools.count()
+        self._warned = False
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _client():
+        try:
+            from jax._src import distributed
+
+            return distributed.global_state.client
+        except Exception:  # pragma: no cover - layout differs across jax
+            return None
+
+    def _fallback(self) -> bool:
+        if not self._warned:
+            self._warned = True
+            logger.warning(
+                "no jax.distributed coordination client: %s falls back to "
+                "device-collective sync (safe only while training is "
+                "quiescent)", self.name)
+        return True
+
+    def _next_key(self, tag: str) -> str:
+        with self._lock:
+            return f"{self.name}/{next(self._seq)}/{tag}"
+
+    def barrier(self, tag: str) -> None:
+        """KV-store sync point; same contract as module-level :func:`barrier`."""
+        if jax.process_count() == 1:
+            return
+        client = self._client()
+        key = self._next_key(tag)
+        if client is None:
+            self._fallback()
+            return barrier(key)
+        client.wait_at_barrier(key, self.timeout_ms)
+
+    def all_hosts_ok(self, ok: bool, tag: str = "all_hosts_ok") -> bool:
+        """True iff EVERY process reports ``ok`` (KV-store vote); same
+        contract as module-level :func:`all_hosts_ok`."""
+        if jax.process_count() == 1:
+            return bool(ok)
+        client = self._client()
+        key = self._next_key(tag)
+        if client is None:
+            self._fallback()
+            return all_hosts_ok(ok, key)
+        client.key_value_set(f"{key}/p{jax.process_index()}",
+                             "1" if ok else "0")
+        # the barrier orders every vote before any read
+        client.wait_at_barrier(key + ".votes_in", self.timeout_ms)
+        flags = client.key_value_dir_get(f"{key}/")
+        bad = sorted(k for k, v in flags if v != "1")
+        if bad:
+            logger.warning("collective vote %r failed on %s", key, bad)
+        # one more sync before cleanup so no host deletes keys a slow peer
+        # has not read yet; deletion is best-effort (stale keys are inert —
+        # the sequence counter never reuses a key)
+        client.wait_at_barrier(key + ".votes_read", self.timeout_ms)
+        if jax.process_index() == 0:
+            try:
+                client.key_value_delete(f"{key}/")
+            except Exception:  # pragma: no cover
+                pass
+        return not bad
 
 
 @contextlib.contextmanager

@@ -23,6 +23,16 @@ construction, no randomness anywhere.
 Registered checkpoint-path points (see ``BaseRecipe.save_checkpoint``):
 
     ckpt_pre_save     before the staging directory is prepared
+    ckpt_async_snapshot
+                      on the TRAINING thread, after joining any previous
+                      in-flight save and before the device->host snapshot
+                      of an asynchronous save (checkpoint.async_save) —
+                      fires as a raised exception in the training loop
+    ckpt_async_commit on the background COMMITTER thread, right after
+                      staging is prepared and before any state is written —
+                      an async-save failure mid-background-write: leaves
+                      only the .tmp staging dir, surfaces at the next join
+                      point (next save / preemption save / teardown)
     ckpt_collective_save
                       inside the COLLECTIVE phase (before the
                       save_model/save_optimizer writers) — exercises the
@@ -31,6 +41,11 @@ Registered checkpoint-path points (see ``BaseRecipe.save_checkpoint``):
     ckpt_pre_commit   after all state is written, before the manifest
     ckpt_pre_rename   after the manifest, before the atomic rename
     ckpt_post_commit  after the rename, before retention GC
+
+    Under asynchronous saves every point from ckpt_async_commit onward is
+    hit on the committer thread; ``fault_point`` is thread-safe and the
+    recipe converts the raise into a ``CheckpointSaveError`` at the next
+    join point.
 
 Input-pipeline points (see ``datasets/prefetch.py``):
 

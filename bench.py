@@ -22,6 +22,10 @@ scale, each in its own subprocess (fresh HBM):
   * ``moe``       — tiny Qwen3-MoE shape (E=8, k=2, dropless): sorted
     grouped-matmul dispatch tok/s, ``moe_vs_baseline`` = sorted/onehot
     ratio (``BENCH_MOE_DISPATCH`` pins one path);
+  * ``ckpt_stall_ms`` — mean train-loop stall per checkpoint save under
+    ``checkpoint.async_save`` (snapshot + join only), with
+    ``ckpt_stall_ms_vs_baseline`` = async/sync stall ratio (lower is
+    better; ``BENCH_CKPT_ASYNC`` pins one mode);
   * ``vlm``       — Gemma-3-VL scale-down (config #4: SigLIP tower +
     Gemma text decoder) at S=2048; reports ``vlm_vs_baseline`` = MFU/0.40
     with BOTH towers' FLOPs accounted.
@@ -107,6 +111,14 @@ SECONDARY = {
     # and dominate here, so this leg's MFU counts them explicitly
     # (model.attention_flops_per_token at S=16384, causal-S/2 convention)
     # on top of the matmul 6N — reported as long_context_16k_vs_baseline.
+    # On the ~0.98 ratio (r05 investigation): the cp-layout/ring work of
+    # PR 3 is structurally absent at cp=1 — no host permutation, no
+    # position injection, no ring/tile-skip in the lowered step (pinned by
+    # test_zigzag.py::test_single_chip_path_free_of_permutation_and_ring) —
+    # so the residual gap vs the 0.40-MFU target is the splash kernel's
+    # partial-diagonal-block compute (masked halves of 512-col kv compute
+    # sub-blocks are executed, ~3-6% over the exact causal S/2 the
+    # denominator counts), not a regression in the input or step path.
     "long_context_16k": [
         "--packed_sequence.packed_sequence_size", "16384",
         "--step_scheduler.global_batch_size", "1",
@@ -130,6 +142,15 @@ SECONDARY = {
     # GShard one-hot dispatch).  ``BENCH_MOE_DISPATCH=sorted|onehot`` pins
     # one path (no ratio).
     "moe": [],
+    # Checkpoint-stall leg: handled by _ckpt_secondary_main — times a
+    # training window containing saves under checkpoint.async_save true vs
+    # false through the real recipe save path.  Reports the mean per-save
+    # TRAIN-LOOP STALL in ms under async (the ckpt_stall timer: join +
+    # snapshot; the background commit overlaps training), with
+    # _vs_baseline = async_stall / sync_stall — the async save win is this
+    # ratio dropping toward the snapshot/save-cost fraction (target <=
+    # 1/3).  ``BENCH_CKPT_ASYNC=1|0`` pins one mode (no ratio).
+    "ckpt_stall_ms": [],
 }
 
 
@@ -348,12 +369,91 @@ def _moe_secondary_main() -> None:
                       "vs_baseline": round(srt / onehot, 4)}))
 
 
+def _ckpt_secondary_main() -> None:
+    """Child process: the checkpoint-stall leg.
+
+    Drives the bench recipe through real training steps with saves
+    interleaved, under ``checkpoint.async_save`` false then true, and
+    reports the mean per-save TRAIN-LOOP STALL (the ``ckpt_stall`` timer:
+    what the loop blocks on — the whole stage/write/commit protocol
+    inline, or join + device->host snapshot under async).  Steps run
+    between saves so the async committer genuinely overlaps training (a
+    commit slower than the save cadence shows up as join time — the
+    honest stall).  Absolute ms depends on this host's disk and transfer
+    path; the async/sync RATIO is the metric (the leg's vs_baseline,
+    lower is better).  ``BENCH_CKPT_ASYNC=1|0`` pins one mode (no ratio).
+    """
+    import gc
+    import shutil
+    import tempfile
+
+    from automodel_tpu.config.arg_parser import parse_args_and_load_config
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
+    )
+
+    saves, steps_between = (2, 1) if SMALL else (3, 2)
+
+    def run(async_mode: str) -> float:
+        d = tempfile.mkdtemp(prefix=f"bench_ckpt_{async_mode}_")
+        overrides = (SMALL_OVERRIDES if SMALL else []) + [
+            "--checkpoint.enabled", "true",
+            "--checkpoint.checkpoint_dir", d,
+            "--checkpoint.async_save", async_mode,
+            "--checkpoint.keep_last_k", "1",
+            "--step_scheduler.ckpt_every_steps", "1000000",  # manual saves
+            "--step_scheduler.num_epochs", "1000",
+        ]
+        cfg = parse_args_and_load_config(
+            ["--config", YAML] + _prefetch_overrides() + overrides)
+        recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+
+        def stream():
+            while True:
+                for g in recipe.step_scheduler:
+                    yield g
+
+        groups = stream()
+        try:
+            recipe._run_train_optim_step(next(groups))  # compile + warm
+            recipe.flush_metrics()
+            recipe.timers.get_elapsed(reset=True)
+            for i in range(saves):
+                for _ in range(steps_between):
+                    recipe._run_train_optim_step(next(groups))
+                # flush first so the save never waits on device work the
+                # sync/async comparison doesn't own
+                recipe.flush_metrics()
+                recipe.save_checkpoint(0, i + 1)
+            stall = recipe.timers.get_elapsed(
+                names=["ckpt_stall"], reset=False)["ckpt_stall"]
+            assert np.isfinite(recipe.last_metrics["loss"])
+            return stall / saves
+        finally:
+            recipe.teardown()  # final background commit joins OFF the clock
+            del recipe
+            gc.collect()
+            shutil.rmtree(d, ignore_errors=True)
+
+    pinned = os.environ.get("BENCH_CKPT_ASYNC", "")
+    if pinned:
+        mode = "true" if pinned in ("1", "true", "yes") else "false"
+        print(json.dumps({"tps": round(run(mode) * 1e3, 2)}))
+        return
+    sync_stall = run("false")
+    async_stall = run("true")
+    print(json.dumps({"tps": round(async_stall * 1e3, 2),
+                      "vs_baseline": round(async_stall / sync_stall, 4)}))
+
+
 def _secondary_main(name: str) -> None:
     """Child process: one secondary config, prints {"tps": ...}."""
     if name == "long_context_16k_cp":
         return _cp_secondary_main()
     if name == "moe":
         return _moe_secondary_main()
+    if name == "ckpt_stall_ms":
+        return _ckpt_secondary_main()
     steps, warmup = (4, 2) if SMALL else (8, 3)
     if name == "unpacked" and not SMALL:
         # two length buckets (1024/1152) after the 128-alignment: warm both

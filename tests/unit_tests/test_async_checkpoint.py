@@ -1,0 +1,451 @@
+"""Asynchronous checkpointing (tier-1; ISSUE 5 tentpole):
+
+* async and sync saves of the same state are byte-identical (host-side
+  files; the model export is compared at the recipe level);
+* a fault during the background write (``ckpt_async_commit``) leaves only
+  a ``.tmp`` staging dir, surfaces as ``CheckpointSaveError`` at the next
+  join point, and resume falls back to the last committed step;
+* at most one save in flight: the next save JOINS the previous one first
+  (and re-raises its error); teardown joins too, leaving no non-daemon
+  committer threads behind;
+* the snapshot is taken at the save boundary — state mutated while the
+  committer is still writing never leaks into the checkpoint;
+* recipe level: a preemption grace-window save blocks until committed; a
+  mid-epoch async save under the prefetching input pipeline
+  (``prefetch_depth > 0``) resumes stitch-exact against an uninterrupted
+  reference stream.
+"""
+
+import hashlib
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from automodel_tpu.checkpoint import checkpointing as ckpt
+from automodel_tpu.recipes.base_recipe import BaseRecipe
+from automodel_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.fault
+
+YAML = os.path.join(os.path.dirname(__file__), "..", "..",
+                    "examples", "llm_finetune", "tiny_llama_mock.yaml")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.reset_faults()
+    yield
+    fi.reset_faults()
+
+
+def _committer_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "automodel-ckpt-committer"]
+
+
+class _Counter:
+    def __init__(self, value=0):
+        self.value = value
+
+    def state_dict(self):
+        return {"value": self.value}
+
+    def load_state_dict(self, sd):
+        self.value = sd["value"]
+
+
+# A stateful whose PICKLING (i.e. the background committer's write) blocks
+# on a module-level gate, making "commit still in flight" a deterministic
+# test state instead of a sleep race.  The gate must be module-level: the
+# snapshot deep-copies state dicts, and threading primitives aren't
+# deep-copyable.
+_GATE = threading.Event()
+
+
+class _GatedPayload:
+    def __deepcopy__(self, memo):
+        return self
+
+    def __reduce__(self):
+        _GATE.wait(timeout=30)
+        return (str, ("gated",))
+
+
+class _Gated:
+    def state_dict(self):
+        return {"payload": _GatedPayload()}
+
+    def load_state_dict(self, sd):
+        pass
+
+
+class _TinyRecipe(BaseRecipe):
+    def __init__(self, ckpt_dir, gated=False, **cfg_kw):
+        super().__init__()
+        self.checkpoint_config = ckpt.CheckpointingConfig(
+            checkpoint_dir=str(ckpt_dir), **cfg_kw)
+        self.counter = _Counter()
+        if gated:
+            self.gate = _Gated()
+
+
+def _dirs(root):
+    return sorted(os.listdir(root)) if os.path.isdir(root) else []
+
+
+# ---------------------------------------------------------------------------
+# Byte identity and join semantics
+# ---------------------------------------------------------------------------
+def test_async_and_sync_checkpoints_byte_identical(tmp_path):
+    ra = _TinyRecipe(tmp_path / "a", async_save=True)
+    rs = _TinyRecipe(tmp_path / "s", async_save=False)
+    for r in (ra, rs):
+        r.counter.value = 41
+    pa = ra.save_checkpoint(0, 2)
+    assert ra.join_pending_save() == pa
+    ps = rs.save_checkpoint(0, 2)
+    for rel in ("counter.pt", ckpt.MANIFEST_NAME):
+        with open(os.path.join(pa, rel), "rb") as f:
+            a = f.read()
+        with open(os.path.join(ps, rel), "rb") as f:
+            s = f.read()
+        assert a == s, f"{rel} differs between async and sync saves"
+    assert ckpt.verify_manifest(pa)["step"] == 2
+
+
+def test_save_returns_before_commit_and_teardown_joins(tmp_path):
+    _GATE.clear()
+    r = _TinyRecipe(tmp_path, gated=True, async_save=True)
+    r.counter.value = 1
+    try:
+        path = r.save_checkpoint(0, 1)
+        # background write is parked on the gate: nothing committed yet,
+        # the loop-side call has already returned
+        assert not ckpt.is_committed(path)
+        assert r._inflight_save is not None
+        assert _committer_threads()
+        # snapshot isolation: mutations after the save boundary must not
+        # reach the in-flight checkpoint
+        r.counter.value = 999
+    finally:
+        _GATE.set()
+    r.teardown()
+    assert ckpt.is_committed(path)
+    assert not _committer_threads(), "committer must exit at teardown"
+    assert not any(t for t in threading.enumerate() if not t.daemon
+                   and t is not threading.main_thread())
+    fresh = _TinyRecipe(tmp_path, async_save=True)
+    fresh.load_checkpoint()
+    assert fresh.counter.value == 1, "snapshot must pin save-boundary state"
+
+
+def test_manifest_hash_reuses_snapshot_digest(tmp_path, monkeypatch):
+    """The write-time sha256 hint is what lands in the manifest — the
+    duplicate re-read of just-written statefuls is gone (build_manifest
+    falls back to hashing only for files written outside save_stateful)."""
+    r = _TinyRecipe(tmp_path, async_save=False)
+    calls = {"n": 0}
+    real = ckpt._file_sha256
+
+    def counting(path, *a, **kw):
+        calls["n"] += 1
+        return real(path, *a, **kw)
+
+    monkeypatch.setattr(ckpt, "_file_sha256", counting)
+    path = r.save_checkpoint(0, 1)
+    # counter.pt came from the hint; no re-hash of any .pt file
+    assert calls["n"] == 0
+    m = ckpt.verify_manifest(path)  # deep verify recomputes and must agree
+    entry = next(e for e in m["files"] if e["path"] == "counter.pt")
+    assert entry["sha256"] == real(os.path.join(path, "counter.pt"))
+
+
+# ---------------------------------------------------------------------------
+# Failure surfacing: background fault -> .tmp only -> next join raises
+# ---------------------------------------------------------------------------
+def test_background_fault_leaves_staging_and_resume_falls_back(tmp_path):
+    r = _TinyRecipe(tmp_path, async_save=True)
+    r.counter.value = 10
+    committed = r.save_checkpoint(0, 1)
+    assert r.join_pending_save() == committed
+
+    fi.configure_faults("ckpt_async_commit:1")
+    r.counter.value = 20
+    r.save_checkpoint(0, 2)  # dispatch succeeds; the COMMIT will fail
+    with pytest.raises(ckpt.CheckpointSaveError) as ei:
+        r.join_pending_save()
+    assert isinstance(ei.value.__cause__, fi.InjectedFault)
+    # only the staging dir exists for step 2; discovery ignores it
+    assert "epoch_0_step_2.tmp" in _dirs(tmp_path)
+    assert "epoch_0_step_2" not in _dirs(tmp_path)
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) == committed
+    fresh = _TinyRecipe(tmp_path, async_save=True)
+    assert fresh.load_checkpoint() == committed
+    assert fresh.counter.value == 10
+
+    # next clean save at the same step clears the leftovers and commits
+    fi.reset_faults()
+    r.counter.value = 21
+    p2 = r.save_checkpoint(0, 2)
+    assert r.join_pending_save() == p2
+    assert ckpt.is_committed(p2)
+
+
+def test_next_save_joins_previous_and_surfaces_its_error(tmp_path):
+    r = _TinyRecipe(tmp_path, async_save=True)
+    fi.configure_faults("ckpt_async_commit:1")
+    r.save_checkpoint(0, 1)
+    # the NEXT save is the join point: it must re-raise save 1's failure
+    # before dispatching, and leave no save of its own behind
+    with pytest.raises(ckpt.CheckpointSaveError):
+        r.save_checkpoint(0, 2)
+    assert r._inflight_save is None
+    assert "epoch_0_step_2.tmp" not in _dirs(tmp_path)
+    assert "epoch_0_step_2" not in _dirs(tmp_path)
+    # with the fault consumed, the retry commits both-ways clean
+    p = r.save_checkpoint(0, 2)
+    assert r.join_pending_save() == p
+
+
+def test_snapshot_fault_raises_in_training_thread(tmp_path):
+    """``ckpt_async_snapshot`` marks the blocking half: it fires as a raised
+    exception in the caller (the training loop), not via the join path."""
+    r = _TinyRecipe(tmp_path, async_save=True)
+    fi.configure_faults("ckpt_async_snapshot:1")
+    with pytest.raises(fi.InjectedFault):
+        r.save_checkpoint(0, 1)
+    assert r._inflight_save is None
+    assert _dirs(tmp_path) == []  # nothing staged, nothing committed
+
+
+def test_abort_purges_manifest_hash_hints(tmp_path):
+    """Any abort that leaves a .tmp must also drop the write-time sha256
+    hints recorded for it — across a long run of transient failures the
+    hint dict would otherwise grow without bound, and a later save at the
+    same step could inherit a stale digest."""
+    ckpt._HASH_HINTS.clear()
+    r = _TinyRecipe(tmp_path, async_save=False)
+    fi.configure_faults("ckpt_pre_commit:1")
+    with pytest.raises(fi.InjectedFault):
+        r.save_checkpoint(0, 1)  # host writes done, abort before commit
+    assert "epoch_0_step_1.tmp" in _dirs(tmp_path)
+    assert not ckpt._HASH_HINTS, "aborted save leaked hash hints"
+    fi.reset_faults()
+    p = r.save_checkpoint(0, 1)
+    assert ckpt.is_committed(p)
+    assert not ckpt._HASH_HINTS  # the retry's own hints were consumed
+
+
+def test_snapshot_host_complete_probe_and_passthrough():
+    """Single-process trees are always host-complete, and the snapshot
+    materializes device leaves to numpy while passing host leaves, None
+    subtrees, and scalars through untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    tree = {"a": jnp.arange(8), "b": np.full(3, 2.0), "c": None, "d": 1.5}
+    assert ckpt.snapshot_is_host_complete(tree)
+    assert ckpt.snapshot_is_host_complete(None)
+    snap = ckpt.snapshot_to_host(tree)
+    assert isinstance(snap["a"], np.ndarray)
+    np.testing.assert_array_equal(snap["a"], np.arange(8))
+    np.testing.assert_array_equal(snap["b"], tree["b"])
+    assert snap["c"] is None and snap["d"] == 1.5
+    assert not isinstance(jax.tree.leaves(snap)[0], jax.Array)
+
+
+def test_async_feasibility_is_voted_across_hosts(tmp_path, monkeypatch):
+    """One host whose local shards can't cover the tree must drag EVERY
+    host to the inline protocol: the feasibility probe votes through
+    ``all_hosts_ok``, so hosts can never split between the background
+    committer's KV-store barriers and the inline device collectives."""
+    from automodel_tpu.utils import dist_utils
+
+    votes = []
+    real = dist_utils.all_hosts_ok
+
+    def veto(ok, tag="all_hosts_ok"):
+        if tag != "ckpt:async_feasible":
+            return real(ok, tag)  # the inline protocol's own votes pass
+        votes.append((bool(ok), tag))
+        return False  # a peer host reported its shards incomplete
+
+    monkeypatch.setattr(dist_utils, "all_hosts_ok", veto)
+    r = _TinyRecipe(tmp_path, async_save=True)
+    r.counter.value = 7
+    path = r.save_checkpoint(0, 1)
+    assert votes == [(True, "ckpt:async_feasible")]
+    assert ckpt.is_committed(path), "vetoed save must commit inline"
+    assert not _committer_threads()
+    assert r._inflight_save is None
+    # the probe result is cached: a second save must not re-vote
+    r.save_checkpoint(0, 2)
+    assert len(votes) == 1
+
+
+def test_timers_survive_cross_thread_record():
+    """The committer records ``ckpt_background`` from its own thread while
+    the loop's profiling interval reads/resets the same Timers — unlocked,
+    elapsed() races stop() into a TypeError and loses commit time."""
+    from automodel_tpu.training.timers import Timers
+
+    timers = Timers()
+    stop, errs = threading.Event(), []
+
+    def committer():
+        try:
+            while not stop.is_set():
+                with timers.record("ckpt_background"):
+                    pass
+        except BaseException as e:  # pragma: no cover - the bug under test
+            errs.append(e)
+
+    t = threading.Thread(target=committer)
+    t.start()
+    try:
+        for _ in range(3000):
+            timers.get_elapsed(reset=True)
+    finally:
+        stop.set()
+        t.join()
+    assert not errs, f"cross-thread timer access raised: {errs[0]!r}"
+
+
+def test_load_checkpoint_joins_inflight_save(tmp_path):
+    _GATE.clear()
+    r = _TinyRecipe(tmp_path, gated=True, async_save=True)
+    r.counter.value = 3
+    try:
+        path = r.save_checkpoint(0, 1)
+        assert not ckpt.is_committed(path)
+    finally:
+        _GATE.set()
+    fresh = _TinyRecipe(tmp_path, async_save=True)
+    # r's commit may still be mid-flight; r.load_checkpoint must join it
+    assert r.load_checkpoint() == path
+    assert fresh.load_checkpoint() == path
+    assert fresh.counter.value == 3
+
+
+# ---------------------------------------------------------------------------
+# Recipe level: preemption, prefetch stitch, thread hygiene
+# ---------------------------------------------------------------------------
+def _make_recipe(ckpt_dir, extra=()):
+    from automodel_tpu.config.arg_parser import parse_args_and_load_config
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
+    )
+
+    argv = ["--config", YAML,
+            "--checkpoint.checkpoint_dir", str(ckpt_dir),
+            "--checkpoint.async_save", "true",
+            "--step_scheduler.val_every_steps", "null"] + list(extra)
+    return TrainFinetuneRecipeForNextTokenPrediction(
+        parse_args_and_load_config(argv))
+
+
+def _run(ckpt_dir, max_steps, extra=()):
+    recipe = _make_recipe(
+        ckpt_dir, ["--step_scheduler.max_steps", str(max_steps)]
+        + list(extra)).setup()
+    hashes = []
+    orig = recipe._run_train_optim_step
+
+    def wrapped(batches):
+        h = hashlib.sha256()
+        for b in batches:
+            for k in sorted(b):
+                h.update(np.asarray(b[k]).tobytes())
+        hashes.append(h.hexdigest())
+        return orig(batches)
+
+    recipe._run_train_optim_step = wrapped
+    recipe.run_train_validation_loop()
+    recipe.flush_metrics()
+    return recipe, hashes
+
+
+@pytest.mark.core
+def test_recipe_midepoch_async_save_resume_stitches(tmp_path):
+    """Mid-epoch async save under ``prefetch_depth > 0``: the snapshot pins
+    the CONSUMED dataloader state, so the resumed run must consume exactly
+    the batches an uninterrupted run would — no skip of queued/staged
+    lookahead, no replay — and no committer thread may outlive a run."""
+    _, h_ref = _run(tmp_path / "ref", 8, ["--checkpoint.enabled", "false"])
+
+    d = tmp_path / "ckpt"
+    r1, h1 = _run(d, 4, ["--dataloader.prefetch_depth", "3"])
+    assert not _committer_threads(), "run loop must join its committer"
+    # the save at max_steps=4 landed mid-epoch and is already committed
+    # (join-on-teardown), holding the consumed-batch loader state
+    sd = r1.dataloader.state_dict()
+    assert sd["index"] > 0, "checkpoint must land mid-epoch for this test"
+    latest = ckpt.find_latest_checkpoint(str(d))
+    assert latest is not None and ckpt.is_committed(latest)
+
+    r2, h2 = _run(d, 8, ["--dataloader.prefetch_depth", "3"])
+    assert r2.step_scheduler.step == 8
+    assert h1 + h2 == h_ref, "async save/resume must stitch exactly"
+
+
+def test_failed_inflight_commit_clears_preempt_saved_flag(tmp_path):
+    """A routine async save whose background commit FAILS must not let a
+    preemption at the same step report "checkpoint saved": the failed join
+    invalidates the last-saved-step marker, so ``_preempt_saved`` tells the
+    operator the truth — resume falls back to an older checkpoint."""
+    import signal
+
+    recipe = _make_recipe(
+        tmp_path, ["--step_scheduler.ckpt_every_steps", "2",
+                   "--step_scheduler.max_steps", "6"]).setup()
+    orig = recipe._run_train_optim_step
+    calls = {"n": 0}
+
+    def step_hook(batches):
+        out = orig(batches)
+        calls["n"] += 1
+        if calls["n"] == 2:
+            # step 2 is a save boundary: its background commit will fail,
+            # and the preemption lands at the same step
+            fi.configure_faults("ckpt_async_commit:1")
+            signal.raise_signal(signal.SIGTERM)
+        return out
+
+    recipe._run_train_optim_step = step_hook
+    recipe.run_train_validation_loop()
+    assert recipe.preempted
+    assert not recipe._preempt_saved, (
+        "preemption must not claim a save whose commit failed")
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) is None
+    assert any(d.endswith(".tmp") for d in _dirs(tmp_path))
+    assert not _committer_threads()
+
+
+def test_recipe_preemption_grace_save_blocks_until_committed(tmp_path):
+    """SIGTERM mid-loop: the grace-window save must be COMMITTED (not just
+    dispatched) by the time the loop returns — the preemptor's hard kill
+    follows, and a still-running committer would be truncated to a .tmp."""
+    import signal
+
+    recipe = _make_recipe(
+        tmp_path, ["--step_scheduler.ckpt_every_steps", "1000"]).setup()
+    orig = recipe._run_train_optim_step
+    calls = {"n": 0}
+
+    def step_then_sigterm(batches):
+        out = orig(batches)
+        calls["n"] += 1
+        if calls["n"] == 2:
+            signal.raise_signal(signal.SIGTERM)
+        return out
+
+    recipe._run_train_optim_step = step_then_sigterm
+    recipe.run_train_validation_loop()
+    assert recipe.preempted and recipe._preempt_saved
+    # committed-at-return is the whole point: check straight away, no join
+    latest = ckpt.find_latest_checkpoint(str(tmp_path))
+    assert latest is not None and ckpt.is_committed(latest)
+    assert not _committer_threads()
+    assert not any(d.endswith(".tmp") for d in _dirs(tmp_path))

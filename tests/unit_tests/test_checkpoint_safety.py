@@ -44,6 +44,10 @@ class _Counter:
 class _TinyRecipe(BaseRecipe):
     def __init__(self, ckpt_dir, **cfg_kw):
         super().__init__()
+        # this suite pins the INLINE protocol (stage/commit/GC semantics are
+        # mode-independent); the async wrapper around the same protocol has
+        # its own suite, tests/unit_tests/test_async_checkpoint.py
+        cfg_kw.setdefault("async_save", False)
         self.checkpoint_config = ckpt.CheckpointingConfig(
             checkpoint_dir=str(ckpt_dir), **cfg_kw)
         self.counter = _Counter()

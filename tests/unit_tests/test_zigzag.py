@@ -385,3 +385,59 @@ def test_train_step_parity_contiguous_vs_zigzag():
     assert np.isfinite(l0) and np.isfinite(l1)
     np.testing.assert_allclose(l1, l0, rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(g1, g0, rtol=1e-3, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# Single-chip gating: cp=1 must pay ZERO zig-zag/ring overhead
+# ---------------------------------------------------------------------------
+def test_single_chip_path_free_of_permutation_and_ring():
+    """The long_context_16k bench leg runs at cp=1 — pin that the cp=1
+    train path carries NONE of the cp machinery (the investigation behind
+    the 0.9775 leg ratio: the shortfall is splash diagonal-block FLOPs
+    accounting, not PR-3 overhead, because none of it is reachable here):
+
+    * ``shard_batch`` leaves the token stream byte-identical and injects no
+      ``position_ids`` (the host permutation is gated on ``cp_size > 1``);
+    * the lowered train step contains no ``ppermute`` (the ring's
+      signature collective — its tile-skip ``lax.cond``s ride inside the
+      ring scan, so no ring means no conds either), while the same model
+      at cp=2/zigzag does.
+    """
+    from automodel_tpu.distributed.shardings import build_parallel_plan
+    from automodel_tpu.loss.masked_ce import IGNORE_INDEX
+    from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from automodel_tpu.optim import build_optimizer
+    from automodel_tpu.training.train_step import build_train_step
+
+    model = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, tie_word_embeddings=True))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 127, (1, 8, 64)).astype(np.int32)
+    labels = np.roll(ids, -1, -1)
+    labels[..., -1] = IGNORE_INDEX
+    stacked = {"input_ids": ids, "labels": labels.astype(np.int32)}
+
+    jaxprs = {}
+    for cp in (1, 2):
+        mm = MeshManager(dp_size=8 // cp, tp_size=1, cp_size=cp,
+                         sequence_parallel=False,
+                         cp_layout="zigzag" if cp > 1 else "contiguous")
+        plan = build_parallel_plan(model, mm)
+        fns = build_train_step(
+            model, build_optimizer(name="adamw", lr=1e-3), plan=plan)
+        params = plan.shard_params(model.init(jax.random.key(0)))
+        opt_state = fns.init_opt_state(params)
+        batch = fns.shard_batch(dict(stacked))
+        if cp == 1:
+            np.testing.assert_array_equal(
+                np.asarray(jax.device_get(batch["input_ids"])), ids)
+            assert "position_ids" not in batch
+        jaxprs[cp] = str(jax.make_jaxpr(
+            lambda p, o, b: fns.train_step(p, o, b))(
+                params, opt_state, batch))
+    assert "ppermute" not in jaxprs[1], (
+        "cp=1 train step must not contain the ring attention collective")
+    assert "ppermute" in jaxprs[2], (
+        "probe is stale: cp=2 zigzag no longer routes through the ring")

@@ -80,11 +80,25 @@ def main(argv=None) -> int:
             # surface uncommitted leftovers for the operator, informationally
             for name in sorted(os.listdir(args.root)):
                 full = os.path.join(args.root, name)
-                if (os.path.isdir(full) and not ckpt.is_committed(full)
-                        and (name.endswith(ckpt.STAGING_SUFFIX)
-                             or ckpt._CKPT_RE.search(name))):
-                    print(f"note  {full}: uncommitted (interrupted save?) — "
-                          "ignored by resume, swept by retention GC")
+                if not os.path.isdir(full) or ckpt.is_committed(full):
+                    continue
+                if name.endswith(ckpt._GC_SUFFIX):
+                    # checked before STAGING_SUFFIX: '.gc.tmp' also ends
+                    # with '.tmp'
+                    print(f"note  {full}: retention-GC husk (interrupted "
+                          "delete or replaced re-save) — ignored by "
+                          "resume, swept by the next successful save")
+                elif name.endswith(ckpt.STAGING_SUFFIX):
+                    # with checkpoint.async_save a .tmp may also be a LIVE
+                    # background commit of a still-running trainer — only
+                    # on a dead run is it an interrupted save's leftover
+                    print(f"note  {full}: uncommitted staging (in-flight "
+                          "background save or interrupted save) — ignored "
+                          "by resume, swept by retention GC")
+                elif ckpt._CKPT_RE.search(name):
+                    print(f"note  {full}: uncommitted (no manifest — "
+                          "pre-protocol legacy dir? see --adopt) — "
+                          "ignored by resume")
     if not targets:
         parser.error("give checkpoint paths or --root")
 
