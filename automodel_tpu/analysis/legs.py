@@ -1,0 +1,146 @@
+"""The dryrun flagship legs as census subjects.
+
+One home for the tiny-model + mesh + train-step constructions that
+``__graft_entry__.dryrun_multichip`` exercises, so the golden-census tier-1
+tests (``tests/unit_tests/test_analysis.py``), the ``tools/lint.py
+--update-golden`` regenerator, and the dryrun itself cannot drift apart.
+
+Legs are built ABSTRACTLY: parameters/optimizer state/batch are
+``ShapeDtypeStruct``s carrying the plan's NamedShardings, so tracing and
+lowering see exactly the placements a real run commits — without
+materializing a single array.  A leg censuses in seconds on the virtual
+8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Tuple
+
+from automodel_tpu.analysis.jaxpr_audit import CollectiveCensus, census_of
+
+# Census legs: the dp2 x cp2 x tp2 flagship under both cp sequence layouts,
+# and the MoE expert-parallel leg (sorted dispatch — the default).
+LEG_NAMES: Tuple[str, ...] = (
+    "dp2xcp2xtp2_contiguous",
+    "dp2xcp2xtp2_zigzag",
+    "moe_ep",
+)
+
+# Audit threshold for the tiny legs: every weight matrix of the tiny
+# flagship (embedding 256x64 bf16 = 32 KiB downwards) is large enough to
+# matter, only the norm/scalar leaves fall under it.
+TINY_AUDIT_MIN_BYTES = 4096
+
+
+def flagship_tiny_model():
+    """The tiny Llama the dryrun jits (see ``__graft_entry__._flagship``)."""
+    import jax.numpy as jnp
+
+    from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, tie_word_embeddings=True)
+    return LlamaForCausalLM(
+        cfg, param_dtype=jnp.bfloat16, compute_dtype=jnp.bfloat16)
+
+
+def moe_tiny_model(tp: int = 2, moe_dispatch: str = "sorted"):
+    """The tiny Mixtral of the dryrun's expert-parallel leg."""
+    import jax.numpy as jnp
+
+    from automodel_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+    return MixtralForCausalLM(
+        MixtralConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, rope_theta=10000.0,
+            tie_word_embeddings=False,
+            num_local_experts=max(2 * tp, 2), num_experts_per_tok=2,
+            output_router_logits=True, moe_group_size=64,
+            moe_dispatch=moe_dispatch),
+        param_dtype=jnp.bfloat16, compute_dtype=jnp.bfloat16)
+
+
+@dataclasses.dataclass
+class Leg:
+    """A census subject: jitted train step + abstract (sharded) args."""
+
+    name: str
+    plan: Any
+    fns: Any                      # TrainStepFns
+    abstract_args: Tuple[Any, ...]  # (params, opt_state, batch) structs
+
+    def census(self, include_hlo: bool = True) -> CollectiveCensus:
+        return census_of(self.fns.train_step, *self.abstract_args,
+                         mesh=self.plan.mesh, include_hlo=include_hlo)
+
+
+def _abstract(tree, shardings):
+    """ShapeDtypeStructs mirroring ``tree`` with ``shardings`` attached."""
+    import jax
+
+    return jax.tree.map(
+        lambda leaf, sh: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                              sharding=sh),
+        tree, shardings)
+
+
+def build_leg(name: str, dp: int = 2, cp: int = 2, tp: int = 2) -> Leg:
+    import jax
+    import jax.numpy as jnp
+
+    from automodel_tpu.distributed.mesh import MeshManager
+    from automodel_tpu.distributed.shardings import build_parallel_plan
+    from automodel_tpu.loss.linear_ce import FusedLinearCrossEntropy
+    from automodel_tpu.optim import build_optimizer
+    from automodel_tpu.training.train_step import build_train_step
+
+    if name not in LEG_NAMES:
+        raise ValueError(f"unknown census leg {name!r}; known: {LEG_NAMES}")
+
+    if name == "moe_ep":
+        # MoE/EP leg keeps the contiguous layout, exactly like the dryrun
+        # (its batches are placed without the zig-zag host permutation).
+        mm = MeshManager(dp_size=dp, tp_size=tp, cp_size=cp,
+                         sequence_parallel=True, cp_layout="contiguous")
+        model = moe_tiny_model(tp=tp)
+        plan = build_parallel_plan(model, mm, expert_parallel=True,
+                                   cp_layout="contiguous")
+        fns = build_train_step(
+            model, build_optimizer(name="adamw", lr=1e-3), plan=plan)
+    else:
+        layout = name.rsplit("_", 1)[1]
+        mm = MeshManager(dp_size=dp, tp_size=tp, cp_size=cp,
+                         sequence_parallel=True, cp_layout=layout)
+        model = flagship_tiny_model()
+        plan = build_parallel_plan(model, mm)
+        fns = build_train_step(
+            model, build_optimizer(name="adamw", lr=1e-3, weight_decay=0.01),
+            loss_fn=FusedLinearCrossEntropy(chunk_len=16), plan=plan)
+
+    abs_params = _abstract(jax.eval_shape(model.init, jax.random.key(0)),
+                           plan.param_sharding)
+    abs_opt = _abstract(jax.eval_shape(fns.init_opt_state, abs_params),
+                        fns.opt_state_sharding)
+    # [A=2 grad-acc, B, S]: the dryrun's batch geometry.
+    B, S = 2 * dp, 16 * cp * tp
+    tok = jax.ShapeDtypeStruct((2, B, S), jnp.int32,
+                               sharding=fns.microbatch_sharding)
+    batch = {"input_ids": tok, "labels": tok}
+    return Leg(name=name, plan=plan, fns=fns,
+               abstract_args=(abs_params, abs_opt, batch))
+
+
+def golden_dir() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "tests", "data", "golden_census")
+
+
+def golden_path(name: str) -> str:
+    return os.path.join(golden_dir(), f"{name}.json")

@@ -144,5 +144,5 @@ def generate(model, params, input_ids, prompt_lens=None,
     prompt_lens = (jnp.full((B,), S, jnp.int32) if prompt_lens is None
                    else jnp.asarray(prompt_lens, jnp.int32))
     left_ids = left_align(input_ids, prompt_lens, config.pad_token_id)
-    return np.asarray(jax.device_get(_generate_jit(
+    return np.asarray(jax.device_get(_generate_jit(  # lint: disable=L004 (one fetch per generate() call AFTER the whole decode scan; the per-token loop is a device-side lax.scan)
         model, params, left_ids, prompt_lens, config, key, prefill_kwargs)))

@@ -631,7 +631,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             with self.timers.record("step_e2e"):
                 self.params, self.opt_state, metrics = self.step_fns.train_step(
                     self.params, self.opt_state, batch)
-                jax.block_until_ready(metrics)
+                jax.block_until_ready(metrics)  # lint: disable=L004 (profiling.barrier measurement mode only: per-step latency is the thing being measured; dispatch overlap is forfeited on purpose)
         else:
             with self.timers.record("dispatch"):
                 self.params, self.opt_state, metrics = self.step_fns.train_step(
@@ -794,10 +794,10 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 # in-flight input buffer stays live in HBM at once (worst
                 # for VLM pixel_values).  Blocking on the running total
                 # bounds the pipeline at 8 staged batches.
-                jax.block_until_ready(total_loss)
+                jax.block_until_ready(total_loss)  # lint: disable=L004 (every-8-batches backpressure bounding staged val input in HBM, not a per-batch fetch)
         if total_loss is None:
             return None
-        loss, tokens = jax.device_get((total_loss, total_tokens))
+        loss, tokens = jax.device_get((total_loss, total_tokens))  # lint: disable=L004 (the PR-2 once-per-epoch fetch: val loss accumulates on device, one d2h at epoch end)
         return float(loss) / max(float(tokens), 1.0)
 
     def run_train_validation_loop(self):

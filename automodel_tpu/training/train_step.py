@@ -329,7 +329,19 @@ def build_train_step(
         }
 
     def init_opt(params):
-        return tx.init(split_params(params)[0])
+        # Initialize against GRAD-dtype params: with ``mu_dtype=None`` optax
+        # infers moment (and injected-hyperparam) dtypes from its input, but
+        # ``tx.update`` consumes ``grad_dtype`` (f32) gradients — an init
+        # from raw bf16 params would flip the opt-state dtypes on the first
+        # update, churning the step's jit cache key into a guaranteed
+        # second XLA compile (caught by the dryrun recompile guard).  An
+        # explicit ``mu_dtype`` still wins: scale_by_adam casts either way.
+        trainable = split_params(params)[0]
+        as_grad = jax.tree.map(
+            lambda p: (p.astype(grad_dtype)
+                       if jnp.issubdtype(p.dtype, jnp.floating) else p),
+            trainable)
+        return tx.init(as_grad)
 
     if plan is not None:
         mesh = plan.mesh

@@ -65,6 +65,24 @@ from typing import Dict, Optional
 FAULT_ENV = "AUTOMODEL_FAULT"
 _KILL_EXIT_CODE = 113  # distinctive, so subprocess tests can assert on it
 
+# The registry of every named crash site in the codebase (documented above).
+# ``fault_point("x")`` call sites are checked against this set by the repo
+# linter (``analysis/lint.py`` rule L005), which also requires each name to
+# be exercised by at least one ``pytest.mark.fault`` test — registering a
+# point here without a drill is itself a lint finding.  Arbitrary names in
+# test SPECS stay legal (tests arm synthetic points); only call sites in
+# the package must be registered.
+KNOWN_FAULT_POINTS = frozenset({
+    "ckpt_pre_save",
+    "ckpt_async_snapshot",
+    "ckpt_async_commit",
+    "ckpt_collective_save",
+    "ckpt_pre_commit",
+    "ckpt_pre_rename",
+    "ckpt_post_commit",
+    "input_producer",
+})
+
 
 class InjectedFault(RuntimeError):
     """Raised by an armed fault point (``mode=raise``)."""

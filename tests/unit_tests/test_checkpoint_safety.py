@@ -196,6 +196,48 @@ def test_fault_after_manifest_before_rename_still_invisible(tmp_path):
     assert _TinyRecipe(tmp_path).load_checkpoint() is None
 
 
+def test_fault_before_staging_leaves_tree_untouched(tmp_path):
+    """``ckpt_pre_save`` fires before the staging dir is even prepared: the
+    earliest possible preemption leaves NO filesystem trace, and a prior
+    commit stays the resume source."""
+    r = _TinyRecipe(tmp_path)
+    r.counter.value = 5
+    committed = r.save_checkpoint(0, 1)
+    fi.configure_faults("ckpt_pre_save:1")
+    r.counter.value = 6
+    with pytest.raises(fi.InjectedFault):
+        r.save_checkpoint(0, 2)
+    assert _dirs(tmp_path) == ["epoch_0_step_1"]  # not even a .tmp
+    fi.reset_faults()
+    r2 = _TinyRecipe(tmp_path)
+    assert r2.load_checkpoint() == committed
+    assert r2.counter.value == 5
+
+
+def test_fault_after_rename_checkpoint_already_durable(tmp_path):
+    """``ckpt_post_commit`` fires after the atomic rename, before retention
+    GC: a kill THERE must lose nothing — the new checkpoint is already
+    committed and discoverable, GC is the only casualty (and the next save
+    sweeps what it missed)."""
+    r = _TinyRecipe(tmp_path, keep_last_k=1)
+    r.save_checkpoint(0, 1)
+    fi.configure_faults("ckpt_post_commit:1")
+    r.counter.value = 30
+    with pytest.raises(fi.InjectedFault):
+        r.save_checkpoint(0, 2)
+    fi.reset_faults()
+    # The save itself is durable despite the post-commit crash...
+    committed = ckpt.find_latest_checkpoint(str(tmp_path))
+    assert committed.endswith("epoch_0_step_2")
+    r2 = _TinyRecipe(tmp_path, keep_last_k=1)
+    assert r2.load_checkpoint() == committed
+    assert r2.counter.value == 30
+    # ... and only GC was skipped: step 1 survives until the next commit.
+    assert "epoch_0_step_1" in _dirs(tmp_path)
+    r2.save_checkpoint(0, 3)
+    assert "epoch_0_step_1" not in _dirs(tmp_path)
+
+
 # ---------------------------------------------------------------------------
 # Retention GC
 # ---------------------------------------------------------------------------

@@ -1,0 +1,260 @@
+"""The repo invariant linter (``analysis/lint.py``): per-rule unit tests on
+synthetic snippets, and THE tier-1 gate — both pillars run over the whole
+package asserting zero unsuppressed findings.
+
+The gate is what turns every rule into a standing invariant: introducing a
+raw ``jax.experimental.shard_map`` import, an unregistered enum knob, a
+``time.time()`` inside a jit function, a stray hot-loop ``device_get`` or
+an undrilled fault point anywhere in ``automodel_tpu/``/``tools/`` fails
+HERE with a rule ID and path:line.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from automodel_tpu.analysis.lint import (
+    Finding,
+    lint_paths,
+    lint_source,
+    parse_suppressions,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _lint(src, rel="automodel_tpu/ops/fake.py", select=None):
+    return lint_source(src, rel, select=select)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# L001 — version-moved JAX APIs
+# ---------------------------------------------------------------------------
+def test_l001_flags_moved_shard_map_imports_and_attrs():
+    hits = _lint("import jax.experimental.shard_map\n")
+    assert _rules(hits) == ["L001"]
+    hits = _lint("from jax.experimental.shard_map import shard_map\n")
+    assert _rules(hits) == ["L001"]
+    hits = _lint("from jax import shard_map\n")
+    assert _rules(hits) == ["L001"]
+    hits = _lint(
+        "import jax\ndef f():\n    return jax.experimental.shard_map."
+        "shard_map(lambda x: x)\n")
+    assert "L001" in _rules(hits)
+
+
+def test_l001_flags_axis_size_and_compiler_params():
+    assert _rules(_lint(
+        "from jax import lax\ndef f(ax):\n    return lax.axis_size(ax)\n"
+    )) == ["L001"]
+    assert _rules(_lint(
+        "from jax.experimental.pallas import tpu as pltpu\n"
+        "p = pltpu.TPUCompilerParams(dimension_semantics=())\n"
+    )) == ["L001"]
+    assert _rules(_lint(
+        "from jax.experimental.pallas import tpu as pltpu\n"
+        "p = pltpu.CompilerParams()\n")) == ["L001"]
+
+
+def test_l001_clean_cases():
+    # the shim itself is exempt
+    assert _lint("from jax.experimental.shard_map import shard_map\n",
+                 rel="automodel_tpu/utils/jax_compat.py") == []
+    # routing through the shim is the sanctioned spelling
+    assert _lint(
+        "from automodel_tpu.utils.jax_compat import axis_size, shard_map\n"
+        "def f(ax):\n    return axis_size(ax)\n") == []
+    # unrelated pallas imports stay legal
+    assert _lint(
+        "from jax.experimental.pallas.ops.tpu.flash_attention import "
+        "flash_attention\n") == []
+
+
+# ---------------------------------------------------------------------------
+# L002 — unregistered enum-like config domains
+# ---------------------------------------------------------------------------
+def test_l002_flags_unregistered_enum_domain():
+    hits = _lint('FOO_MODES = ("fast", "slow")\n')
+    assert _rules(hits) == ["L002"]
+    assert "FOO_MODES" in hits[0].message
+
+
+def test_l002_registered_and_non_enum_constants_clean():
+    # CP_LAYOUTS / MOE_DISPATCHES are registered in loader._enum_fields
+    assert _lint('CP_LAYOUTS = ("contiguous", "zigzag")\n') == []
+    assert _lint('MOE_DISPATCHES = ("sorted", "onehot")\n') == []
+    # key lists / non-string tuples / short tuples are not enum domains
+    assert _lint('_PACKED_KEYS = ("loss", "grad_norm")\n') == []
+    assert _lint('FOO_MODES = (1, 2)\n') == []
+    assert _lint('FOO_MODES = ("solo",)\n') == []
+
+
+# ---------------------------------------------------------------------------
+# L003 — nondeterminism / wall-clock under jit
+# ---------------------------------------------------------------------------
+def test_l003_flags_wallclock_and_nondeterminism_in_jit_scope():
+    hits = _lint(
+        "import jax, time\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    t = time.time()\n"
+        "    return x + t\n")
+    assert _rules(hits) == ["L003"]
+    hits = _lint(
+        "import jax\nimport numpy as np\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=0)\n"
+        "def step(n, x):\n"
+        "    return x + np.random.rand(n)\n")
+    assert _rules(hits) == ["L003"]
+
+
+def test_l003_covers_functions_jitted_at_call_sites():
+    hits = _lint(
+        "import jax, random\n"
+        "def step(x):\n"
+        "    return x * random.random()\n"
+        "step_jit = jax.jit(step, donate_argnums=(0,))\n")
+    assert _rules(hits) == ["L003"]
+
+
+def test_l003_clean_outside_jit_and_for_jax_random():
+    assert _lint(
+        "import time\n"
+        "def host_loop(x):\n"
+        "    return time.time()\n") == []
+    assert _lint(
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(key, x):\n"
+        "    return x + jax.random.normal(key, x.shape)\n") == []
+
+
+# ---------------------------------------------------------------------------
+# L004 — host syncs in the hot path
+# ---------------------------------------------------------------------------
+def test_l004_flags_sync_calls_in_hot_modules():
+    src = ("import jax\n"
+           "def f(arr, m):\n"
+           "    jax.device_get(arr)\n"
+           "    arr.block_until_ready()\n"
+           "    x = arr.item()\n"
+           "    y = float(m['loss'])\n")
+    hits = _lint(src, rel="automodel_tpu/training/fake.py")
+    assert _rules(hits) == ["L004"] * 4
+    # recipes: only the _run_* hot-loop bodies are in scope
+    wrapped = ("import jax\n"
+               "def _run_train_optim_step(self, arr):\n"
+               "    jax.device_get(arr)\n"
+               "def setup(self, arr):\n"
+               "    jax.device_get(arr)\n")
+    hits = _lint(wrapped, rel="automodel_tpu/recipes/llm/fake.py")
+    assert [(f.rule, f.line) for f in hits] == [("L004", 3)]
+
+
+def test_l004_not_applied_outside_hot_modules():
+    src = "import jax\ndef f(arr):\n    return jax.device_get(arr)\n"
+    assert _lint(src, rel="automodel_tpu/checkpoint/fake.py") == []
+    assert _lint(src, rel="tools/fake.py") == []
+
+
+def test_l004_suppression_requires_justification():
+    base = ("import jax\n"
+            "def f(arr):\n"
+            "    jax.device_get(arr)  # lint: disable=L004{}\n")
+    justified = base.format(" (once-per-epoch fetch)")
+    bare = base.format("")
+    assert _lint(justified, rel="automodel_tpu/training/fake.py") == []
+    assert _rules(_lint(bare, rel="automodel_tpu/training/fake.py")) == [
+        "L004"]
+
+
+def test_suppression_parser():
+    sup = parse_suppressions(
+        "x = 1\n"
+        "y  # lint: disable=L001,L004 (reason here)\n"
+        "z  # lint: disable=L003\n")
+    assert sup == {2: {"L001", "L004"}}
+
+
+# ---------------------------------------------------------------------------
+# L005 — fault-point registry + drill coverage
+# ---------------------------------------------------------------------------
+def test_l005_flags_unregistered_fault_point():
+    hits = _lint(
+        "from automodel_tpu.utils.fault_injection import fault_point\n"
+        "def save():\n"
+        "    fault_point('ckpt_totally_new_point')\n")
+    assert _rules(hits) == ["L005"]
+    assert "not registered" in hits[0].message
+
+
+def test_l005_registered_and_drilled_point_clean():
+    assert _lint(
+        "from automodel_tpu.utils.fault_injection import fault_point\n"
+        "def save():\n"
+        "    fault_point('ckpt_pre_commit')\n") == []
+
+
+def test_l005_registry_matches_docstring_points():
+    from automodel_tpu.utils.fault_injection import KNOWN_FAULT_POINTS
+
+    assert "ckpt_pre_save" in KNOWN_FAULT_POINTS
+    assert "input_producer" in KNOWN_FAULT_POINTS
+
+
+# ---------------------------------------------------------------------------
+# Rule selection + output formats
+# ---------------------------------------------------------------------------
+def test_select_restricts_rules():
+    src = ("import jax, time\n"
+           "FOO_MODES = ('a', 'b')\n"
+           "@jax.jit\n"
+           "def step(x):\n"
+           "    return x + time.time()\n")
+    assert _rules(_lint(src)) == ["L002", "L003"]
+    assert _rules(_lint(src, select=["L003"])) == ["L003"]
+
+
+def test_finding_format_carries_rule_id_and_location():
+    f = Finding("L001", "automodel_tpu/ops/x.py", 12, "msg")
+    assert f.format() == "automodel_tpu/ops/x.py:12: L001 msg"
+
+
+# ---------------------------------------------------------------------------
+# THE tier-1 gate: the whole tree is lint-clean
+# ---------------------------------------------------------------------------
+def test_repo_is_lint_clean():
+    paths = [os.path.join(_REPO, p)
+             for p in ("automodel_tpu", "tools", "__graft_entry__.py")]
+    findings = lint_paths(paths, repo_root=_REPO)
+    assert findings == [], (
+        "unsuppressed lint findings (fix, or suppress with "
+        "`# lint: disable=L00x (reason)` where the behavior is "
+        "intentional):\n" + "\n".join(f.format() for f in findings))
+
+
+def test_cli_exits_zero_and_emits_json(tmp_path):
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "lint.py"),
+         "--format", "json"],
+        capture_output=True, text=True, env=env, cwd=_REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []
+
+
+def test_cli_fails_on_a_seeded_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax.experimental.shard_map\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "lint.py"), str(bad)],
+        capture_output=True, text=True, cwd=_REPO)
+    assert proc.returncode == 1
+    assert "L001" in proc.stdout and "bad.py:1" in proc.stdout

@@ -423,12 +423,14 @@ def test_sorted_path_layout_audit_under_expert_parallel_mesh():
         return moe.sorted_expert_ffn(xg, w8, idx, wg, wu, wd, capacity=M,
                                      compute_dtype=jnp.float32)
 
+    from automodel_tpu.analysis.jaxpr_audit import jaxpr_census
+
     ref = fn(xg, wg, wu, wd)
     mm = MeshManager(dp_size=2, cp_size=2, tp_size=2)
     with sharding_context(mm.mesh, rules):
-        jaxpr = str(jax.make_jaxpr(fn)(xg, wg, wu, wd))
+        census = jaxpr_census(jax.make_jaxpr(fn)(xg, wg, wu, wd))
         # token buffer, silu intermediate, down-proj out, final [G, M, H]
-        assert jaxpr.count("sharding_constraint") >= 4
+        assert census.sharding_constraints >= 4, census
         out = jax.jit(fn)(xg, wg, wu, wd)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)

@@ -419,7 +419,9 @@ def test_single_chip_path_free_of_permutation_and_ring():
     labels[..., -1] = IGNORE_INDEX
     stacked = {"input_ids": ids, "labels": labels.astype(np.int32)}
 
-    jaxprs = {}
+    from automodel_tpu.analysis.jaxpr_audit import jaxpr_census
+
+    censuses = {}
     for cp in (1, 2):
         mm = MeshManager(dp_size=8 // cp, tp_size=1, cp_size=cp,
                          sequence_parallel=False,
@@ -434,10 +436,12 @@ def test_single_chip_path_free_of_permutation_and_ring():
             np.testing.assert_array_equal(
                 np.asarray(jax.device_get(batch["input_ids"])), ids)
             assert "position_ids" not in batch
-        jaxprs[cp] = str(jax.make_jaxpr(
+        censuses[cp] = jaxpr_census(jax.make_jaxpr(
             lambda p, o, b: fns.train_step(p, o, b))(
                 params, opt_state, batch))
-    assert "ppermute" not in jaxprs[1], (
-        "cp=1 train step must not contain the ring attention collective")
-    assert "ppermute" in jaxprs[2], (
-        "probe is stale: cp=2 zigzag no longer routes through the ring")
+    assert censuses[1].count("ppermute") == 0, (
+        "cp=1 train step must not contain the ring attention collective; "
+        f"census: {censuses[1].collectives}")
+    assert censuses[2].count("ppermute", "cp") > 0, (
+        "probe is stale: cp=2 zigzag no longer routes through the ring; "
+        f"census: {censuses[2].collectives}")
