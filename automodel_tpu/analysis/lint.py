@@ -27,6 +27,13 @@ it drifts silently):
   ``utils/fault_injection.py::KNOWN_FAULT_POINTS`` and be exercised by at
   least one ``pytest.mark.fault`` test — an undrilled crash site is a
   crash-safety claim nobody ever tested.
+* **L006** — raw Pallas construction (``pl.BlockSpec`` / ``pl.GridSpec`` /
+  ``pltpu.PrefetchScalarGridSpec``, or direct ``pallas_tpu_compiler_params``
+  calls) outside ``ops/kernel_lib/``: every kernel builds its blocks,
+  grids and compiler params through the substrate
+  (``ops/kernel_lib/tiling.py``) so block-size choices stay on the
+  autotuner and the VMEM-limit defaults stay uniform — a kernel that
+  drifts off the substrate silently loses both.
 
 Suppression syntax (same line as the finding)::
 
@@ -52,6 +59,8 @@ RULES: Dict[str, str] = {
     "L004": "host-sync call in a hot-loop module",
     "L005": "fault point not registered or not covered by a "
             "fault-marked test",
+    "L006": "raw Pallas BlockSpec/grid-spec/compiler-params construction "
+            "outside ops/kernel_lib/",
 }
 
 # L001: the moved-API table.  Keys are dotted attribute chains / import
@@ -104,6 +113,10 @@ _NONDET_PREFIXES = ("np.random.", "numpy.random.", "random.")
 _SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
 _SYNC_METHODS = {"item", "block_until_ready"}
 _METRIC_NAMES_RE = re.compile(r"^(m|dm|dmv|metrics|device_metrics)$")
+
+# L006: Pallas grid/block construction belongs to the kernel substrate.
+_L006_GRID_NAMES = {"BlockSpec", "GridSpec", "PrefetchScalarGridSpec"}
+_L006_EXEMPT_PREFIX = "automodel_tpu/ops/kernel_lib/"
 
 _SUPPRESS_RE = re.compile(
     r"#\s*lint:\s*disable=([A-Z0-9,\s]+?)\s*\(([^)]+)\)")
@@ -299,6 +312,7 @@ class _FileLinter(ast.NodeVisitor):
         self.is_compat_shim = rel_path.replace(os.sep, "/").endswith(
             "utils/jax_compat.py")
         posix = rel_path.replace(os.sep, "/")
+        self.is_kernel_lib = _L006_EXEMPT_PREFIX in posix
         self.hot_file = any(d in posix for d in _HOT_DIRS)
         self.recipes_file = _RECIPES_DIR in posix
         self._jit_names = _jit_called_names(tree)
@@ -335,6 +349,16 @@ class _FileLinter(ast.NodeVisitor):
                         "L001", node,
                         f"'from {node.module} import {alias.name}' is a "
                         f"version-moved API; use {shim}")
+        if (not self.is_compat_shim and not self.is_kernel_lib
+                and node.module and "pallas" in node.module):
+            for alias in node.names:
+                if alias.name in _L006_GRID_NAMES:
+                    self._emit(
+                        "L006", node,
+                        f"'from {node.module} import {alias.name}': build "
+                        "Pallas block/grid specs through ops/kernel_lib/"
+                        "tiling.py (the substrate's single construction "
+                        "path)")
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
@@ -387,6 +411,23 @@ class _FileLinter(ast.NodeVisitor):
                            "jax.random key instead")
         if self.hot_file or self._hot_depth > 0:
             self._check_sync_call(node, chain)
+        if not (self.is_kernel_lib or self.is_compat_shim) and chain:
+            tail = chain.split(".")[-1]
+            base = chain.rsplit(".", 1)[0] if "." in chain else ""
+            if (tail in _L006_GRID_NAMES
+                    and base.split(".")[-1] in _PALLAS_TPU_BASES
+                    | {"pl", "pallas"}):
+                self._emit(
+                    "L006", node,
+                    f"raw {chain!r} construction: build Pallas block/grid "
+                    "specs through ops/kernel_lib/tiling.py (the "
+                    "substrate's single construction path)")
+            elif tail == "pallas_tpu_compiler_params":
+                self._emit(
+                    "L006", node,
+                    "call kernel_lib.tiling.compiler_params (which applies "
+                    "the substrate's VMEM-limit default) instead of the "
+                    "raw jax_compat shim")
         if chain and chain.split(".")[-1] == "fault_point" and node.args:
             arg = node.args[0]
             if isinstance(arg, ast.Constant) and isinstance(arg.value, str):

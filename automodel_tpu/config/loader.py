@@ -359,12 +359,25 @@ def _enum_fields():
     must fail with the valid set listed before any mesh / train step is built
     from it.  Allowed sets live with their owning modules (single source of
     truth); resolved lazily to keep this module import-light."""
+    from automodel_tpu.ops.kernel_lib.autotune import AUTOTUNE_MODES
     from automodel_tpu.ops.moe import MOE_DISPATCHES
     from automodel_tpu.ops.zigzag import CP_LAYOUTS
 
     return {
         "distributed.cp_layout": CP_LAYOUTS,
         "moe.dispatch": MOE_DISPATCHES,
+        "kernels.autotune": AUTOTUNE_MODES,
+    }
+
+
+def _enum_normalizers():
+    """Field-specific pre-validation normalizers (beyond the shared null
+    spellings).  ``kernels.autotune: on`` is a YAML 1.1 bool literal, so
+    bools must map back onto the mode names before the membership check."""
+    from automodel_tpu.ops.kernel_lib.autotune import normalize_autotune_mode
+
+    return {
+        "kernels.autotune": normalize_autotune_mode,
     }
 
 
@@ -389,11 +402,12 @@ def normalize_null_spelling(v: Any) -> Any:
 def validate_config_enums(cfg: "ConfigNode") -> None:
     """Raise ValueError for any registered enum field holding a value outside
     its allowed set (None/null always passes — it means "use the default")."""
+    normalizers = _enum_normalizers()
     for dotted, allowed in _enum_fields().items():
         v = cfg.get(dotted, _UNSET)
         if v is _UNSET:
             continue
-        v = normalize_null_spelling(v)
+        v = normalizers.get(dotted, normalize_null_spelling)(v)
         if v is None:
             continue
         if v not in allowed:

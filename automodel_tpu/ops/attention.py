@@ -165,20 +165,29 @@ def attention(
     """Backend dispatcher — the framework's attention entry point.
 
     Reference analogue: the fa3->fa2->sdpa fallback chain
-    (``_transformers/auto_model.py:50-144``), TPU-ified:
+    (``_transformers/auto_model.py:50-144``), TPU-ified and DATA-DRIVEN:
+    the rungs live in the kernel registry (``ops/kernel_lib/registry``),
+    each registered by its kernel module with a capability probe, and this
+    entry point builds one request and resolves the chain —
 
-    * active sharding context with ``cp > 1``  -> **ring attention**
-      (``shard_map`` + ``ppermute`` over the cp axis; the reference's
-      ``context_parallel``, ``distributed/cp_utils.py:102-149``);
-    * TPU backend + block-aligned shapes       -> **splash attention**
+    * ``attention.ring``   — active sharding context with ``cp > 1``
+      (``shard_map`` + ``ppermute`` over the cp axis; unconditional
+      precedence — see the probe's rationale in ``ops/ring_attention.py``);
+    * ``attention.splash`` — TPU backend + block-aligned shapes
       (segment-id native, GQA without kv repeat, causal blocks skipped);
-    * otherwise                                -> XLA SDPA (this module) —
-      always correct under GSPMD, used on CPU test meshes.
+    * ``attention.flash``  — older-JAX/odd-shape TPU traffic without soft
+      caps or windows (kv heads repeated for GQA);
+    * ``attention.sdpa``   — XLA SDPA (this module), the always-available
+      anchor: correct under GSPMD, the CPU test path, and the only rung
+      that can express a TRACED sliding window (a per-layer scalar riding
+      a scan — static int windows go to splash, whose LocalMask skips
+      off-window blocks outright).
     """
     from automodel_tpu.distributed.shardings import (
         current_cp_layout,
         current_sharding,
     )
+    from automodel_tpu.ops.kernel_lib import registry as kernel_registry
 
     if local_window_size is not None and not causal:
         raise NotImplementedError(
@@ -186,95 +195,50 @@ def attention(
             "window trails the query position)")
 
     ctx = current_sharding()
-    if ctx is not None:
-        mesh, _rules = ctx
-        if "cp" in mesh.shape and mesh.shape["cp"] > 1:
-            # context parallelism takes UNCONDITIONAL precedence: windows
-            # and soft caps are both applied per tile inside the ring
-            # (position arithmetic / tanh before the online softmax), so no
-            # cp>1 traffic ever falls through to a path that would assume
-            # arange token order — under the zig-zag layout SDPA's built-in
-            # causal mask would be silently wrong.  The layout rides the
-            # sharding context: it must match the host-side batch
-            # permutation (ops/zigzag.py).
-            from automodel_tpu.ops.ring_attention import sharded_ring_attention
+    mesh = ctx[0] if ctx is not None else None
+    cp_active = (mesh is not None and "cp" in mesh.shape
+                 and mesh.shape["cp"] > 1)
+    request = {
+        "kind": "attention",
+        "q_seq": q.shape[1], "kv_seq": k.shape[1], "head_dim": q.shape[3],
+        "num_q_heads": q.shape[2], "num_kv_heads": k.shape[2],
+        "dtype": str(q.dtype),
+        "causal": causal,
+        "soft_cap": logits_soft_cap is not None,
+        "window": local_window_size is not None,
+        "traced_window": (local_window_size is not None
+                          and not isinstance(local_window_size, int)),
+        "cp_active": cp_active,
+        "mesh": mesh,
+        "cp_layout": current_cp_layout() if cp_active else None,
+    }
+    spec = kernel_registry.resolve("attention.ring", request)
+    return spec.impl(
+        request, q, k, v, causal=causal, segment_ids=segment_ids,
+        attention_mask=attention_mask, scale=scale,
+        logits_soft_cap=logits_soft_cap,
+        local_window_size=local_window_size)
 
-            seg = fold_padding_into_segments(
-                q.shape[:2], segment_ids, attention_mask)
-            return sharded_ring_attention(
-                q, k, v, mesh, causal=causal, segment_ids=seg, scale=scale,
-                local_window_size=local_window_size,
-                logits_soft_cap=logits_soft_cap,
-                layout=current_cp_layout())
 
-    if local_window_size is not None and not isinstance(
-            local_window_size, int):
-        # TRACED window (e.g. per-layer scalar riding a scan): only SDPA
-        # can express it.  Static int windows fall through to splash, whose
-        # LocalMask skips off-window blocks outright (Gemma3 dispatches
-        # per-layer lax.cond branches with static windows to get here).
-        return dot_product_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids,
-            attention_mask=attention_mask, scale=scale,
-            logits_soft_cap=logits_soft_cap,
-            local_window_size=local_window_size)
+# ---------------------------------------------------------------------------
+# Registry rung: the XLA SDPA anchor (always available, always correct)
+# ---------------------------------------------------------------------------
+def _sdpa_probe(request) -> bool:
+    return True
 
-    # Kernel fallback chain on AVAILABILITY at every rung: splash -> flash ->
-    # SDPA.  Each rung is tried when its module imports AND its availability
-    # predicate passes — previously the flash rung was reachable only when
-    # the splash IMPORT raised, so "splash imports fine but is unavailable
-    # (shape/backend)" skipped flash entirely and dropped to XLA SDPA.
-    try:
-        from automodel_tpu.ops.splash_attention import (
-            sharded_splash_attention,
-            splash_attention_available,
-            splash_attention_bshd,
-        )
-    except ImportError:
-        splash_attention_available = None
 
-    if (splash_attention_available is not None
-            and splash_attention_available(q.shape[1], k.shape[1],
-                                           q.shape[3])):
-        if ctx is not None:
-            # pallas_call must run per-shard under GSPMD
-            return sharded_splash_attention(
-                q, k, v, ctx[0], causal=causal, segment_ids=segment_ids,
-                attention_mask=attention_mask, scale=scale,
-                logits_soft_cap=logits_soft_cap,
-                local_window_size=local_window_size)
-        return splash_attention_bshd(
-            q, k, v, causal=causal, segment_ids=segment_ids,
-            attention_mask=attention_mask, scale=scale,
-            logits_soft_cap=logits_soft_cap,
-            local_window_size=local_window_size)
-
-    # Plain Pallas flash attention (kv heads repeated for GQA): the
-    # secondary TPU path — older JAX without splash, or shapes splash
-    # declines that flash can still take.
-    if logits_soft_cap is None and local_window_size is None:
-        try:
-            from automodel_tpu.ops.flash_attention import (
-                flash_attention_available,
-                flash_attention_bshd,
-                sharded_flash_attention,
-            )
-        except ImportError:
-            flash_attention_available = None
-
-        if (flash_attention_available is not None
-                and flash_attention_available(q.shape[1], k.shape[1],
-                                              q.shape[3])):
-            if ctx is not None:
-                return sharded_flash_attention(
-                    q, k, v, ctx[0], causal=causal, segment_ids=segment_ids,
-                    attention_mask=attention_mask, scale=scale)
-            return flash_attention_bshd(
-                q, k, v, causal=causal, segment_ids=segment_ids,
-                attention_mask=attention_mask, scale=scale)
-
+def _sdpa_impl(request, q, k, v, *, causal=True, segment_ids=None,
+               attention_mask=None, scale=None, logits_soft_cap=None,
+               local_window_size=None):
     return dot_product_attention(
         q, k, v, causal=causal, segment_ids=segment_ids,
         attention_mask=attention_mask, scale=scale,
         logits_soft_cap=logits_soft_cap,
         local_window_size=local_window_size)
+
+
+from automodel_tpu.ops.kernel_lib import registry as _registry  # noqa: E402
+
+_registry.register_kernel(
+    "attention.sdpa", probe=_sdpa_probe, impl=_sdpa_impl,
+    fallback=None, reference=_sdpa_impl)

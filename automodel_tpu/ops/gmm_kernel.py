@@ -54,13 +54,13 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from automodel_tpu.utils.jax_compat import pallas_tpu_compiler_params
+from automodel_tpu.ops.kernel_lib import autotune, registry, tiling
 
 # Pallas interpret mode: lets the CPU test suite execute the real kernel
 # logic (tests monkeypatch this, mirroring ops/linear_ce_kernel.py).
 _INTERPRET = False
 
-_LANE = 128
+_LANE = tiling.LANE
 
 
 def gmm_kernel_available(m: int, k: int, n: int) -> bool:
@@ -76,23 +76,35 @@ def gmm_kernel_available(m: int, k: int, n: int) -> bool:
         return False
 
 
+def _tile_bytes(tm: int, tn: int, k: int) -> int:
+    """VMEM working set of one (tm, tn) tile pair: double-buffered lhs/rhs
+    blocks + fp32 accumulator + out block.  ONE byte model — shared by the
+    runtime tile search/validate AND the sweep's candidate filter, so an
+    estimate change can never let the sweep persist a winner the runtime
+    would reject."""
+    return (2 * tm * k * 2 + 2 * k * tn * 2    # lhs/rhs double-buffer
+            + tm * tn * 4                      # fp32 accumulator
+            + 2 * tm * tn * 2)                 # out block
+
+
 def _tiles(m: int, k: int, n: int,
-           budget: int = 24 * 1024 * 1024) -> Tuple[int, int]:
-    """(tm rows, tn cols): largest tile pair whose double-buffered lhs/rhs
-    blocks + fp32 accumulator fit the budget (same sizing philosophy as
+           budget: int = tiling.DEFAULT_TILE_BUDGET_BYTES) -> Tuple[int, int]:
+    """(tm rows, tn cols): largest tile pair whose ``_tile_bytes`` fit the
+    budget (``tiling.fit_tile_pair`` — same sizing philosophy as
     linear_ce_kernel._tiles; tails are masked/padded, so only the 128 lane
-    constrains shapes)."""
-    best = (128, 128)
-    for tm in (512, 256, 128):
-        if tm > ((m + 127) // 128) * 128:
-            continue
-        for tn in (512, 256, 128):
-            use = (2 * tm * k * 2 + 2 * k * tn * 2    # lhs/rhs double-buffer
-                   + tm * tn * 4                      # fp32 accumulator
-                   + 2 * tm * tn * 2)                 # out block
-            if use <= budget and tm * tn > best[0] * best[1]:
-                best = (tm, tn)
-    return best
+    constrains shapes).  A persisted autotune winner (kernel key ``"gmm"``)
+    overrides when it fits."""
+    def use(tm: int, tn: int) -> int:
+        return _tile_bytes(tm, tn, k)
+
+    default = tiling.fit_tile_pair(
+        m, (512, 256, 128), (512, 256, 128), use, budget)
+    fields = {"m": autotune.shape_bucket(m), "k": k, "n": n}
+    return autotune.lookup(
+        "gmm", fields, default,
+        validate=lambda c: (len(c) == 2 and c[0] % _LANE == 0
+                            and c[1] % _LANE == 0
+                            and use(c[0], c[1]) <= budget))
 
 
 # ---------------------------------------------------------------------------
@@ -204,22 +216,22 @@ def _gmm_pallas(lhs: jnp.ndarray, rhs: jnp.ndarray,
     grid = (np_ // tn, meta["num_items"])
     out = pl.pallas_call(
         functools.partial(_gmm_kernel, tm=tm),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
+        grid_spec=tiling.prefetch_grid_spec(
             num_scalar_prefetch=7,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((tm, k), lambda j, w, gid, mid, *_: (mid[w], 0)),
-                pl.BlockSpec((1, k, tn),
-                             lambda j, w, gid, mid, *_: (gid[w], 0, j)),
+                tiling.block_spec((tm, k),
+                                  lambda j, w, gid, mid, *_: (mid[w], 0)),
+                tiling.block_spec((1, k, tn),
+                                  lambda j, w, gid, mid, *_: (gid[w], 0, j)),
             ],
-            out_specs=pl.BlockSpec(
+            out_specs=tiling.block_spec(
                 (tm, tn), lambda j, w, gid, mid, *_: (mid[w], j)),
             scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((mp, np_), lhs.dtype),
-        compiler_params=pallas_tpu_compiler_params(
-            dimension_semantics=("parallel", "arbitrary"),
-            vmem_limit_bytes=64 * 1024 * 1024),
+        compiler_params=tiling.compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=2 * mp * k * np_, transcendentals=0,
             bytes_accessed=mp * k * lhs.dtype.itemsize
@@ -269,21 +281,22 @@ def _tgmm_pallas(lhs: jnp.ndarray, dout: jnp.ndarray,
     grid = (np_ // tn, meta["num_items"])
     out = pl.pallas_call(
         functools.partial(_tgmm_kernel, tm=tm),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
+        grid_spec=tiling.prefetch_grid_spec(
             num_scalar_prefetch=7,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((tm, k), lambda j, w, gid, mid, *_: (mid[w], 0)),
-                pl.BlockSpec((tm, tn), lambda j, w, gid, mid, *_: (mid[w], j)),
+                tiling.block_spec((tm, k),
+                                  lambda j, w, gid, mid, *_: (mid[w], 0)),
+                tiling.block_spec((tm, tn),
+                                  lambda j, w, gid, mid, *_: (mid[w], j)),
             ],
-            out_specs=pl.BlockSpec(
+            out_specs=tiling.block_spec(
                 (1, k, tn), lambda j, w, gid, mid, *_: (gid[w], 0, j)),
             scratch_shapes=[pltpu.VMEM((k, tn), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((E, k, np_), lhs.dtype),
-        compiler_params=pallas_tpu_compiler_params(
-            dimension_semantics=("parallel", "arbitrary"),
-            vmem_limit_bytes=64 * 1024 * 1024),
+        compiler_params=tiling.compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=2 * mp * k * np_, transcendentals=0,
             bytes_accessed=2 * mp * (k + np_) * lhs.dtype.itemsize),
@@ -350,15 +363,102 @@ def gmm(lhs: jnp.ndarray, rhs: jnp.ndarray, group_sizes: jnp.ndarray, *,
     size is a multiple of ``block_rows`` (and ``m`` too) — it selects the
     efficient XLA fallback off-TPU; the Pallas kernel never needs it.
     Differentiable w.r.t. ``lhs``/``rhs`` on every path.
+
+    Dispatch is data-driven through the kernel registry: ``gmm.pallas`` ->
+    ``gmm.xla_blocked`` -> ``gmm.ragged`` (dense, the anchor).
     """
     m, k = lhs.shape
     n = rhs.shape[-1]
-    if gmm_kernel_available(m, k, n):
-        return _gmm_pallas_diff(lhs, rhs, group_sizes)
-    if block_aligned and m % block_rows == 0:
-        return _gmm_xla_blocked(lhs, rhs, group_sizes, block_rows)
-    if not hasattr(lax, "ragged_dot"):      # pragma: no cover - old jax
-        raise NotImplementedError(
-            "gmm needs TPU/interpret Pallas, block-aligned groups, or "
-            "jax.lax.ragged_dot")
+    request = {"kind": "gmm", "m": m, "k": k, "n": n,
+               "block_aligned": bool(block_aligned),
+               "block_rows": int(block_rows),
+               "dtype": str(lhs.dtype)}
+    return registry.dispatch("gmm.pallas", request, lhs, rhs, group_sizes)
+
+
+# ---------------------------------------------------------------------------
+# Registry rungs + autotune adapter
+# ---------------------------------------------------------------------------
+def _gmm_reference(request, lhs, rhs, group_sizes):
+    """Dense XLA oracle: per-group segment einsum via one-hot group ids —
+    O(E*m*k*n), parity-harness only."""
+    m = lhs.shape[0]
+    E = rhs.shape[0]
+    ends = jnp.cumsum(group_sizes.astype(jnp.int32))
+    starts = ends - group_sizes.astype(jnp.int32)
+    rows = jnp.arange(m, dtype=jnp.int32)
+    onehot = ((rows[:, None] >= starts[None, :])
+              & (rows[:, None] < ends[None, :])).astype(lhs.dtype)  # [m, E]
+    return jnp.einsum("me,mk,ekn->mn", onehot, lhs, rhs,
+                      preferred_element_type=jnp.float32).astype(lhs.dtype)
+
+
+def _gmm_pallas_probe(request) -> bool:
+    return gmm_kernel_available(request["m"], request["k"], request["n"])
+
+
+def _gmm_pallas_impl(request, lhs, rhs, group_sizes):
+    return _gmm_pallas_diff(lhs, rhs, group_sizes)
+
+
+def _gmm_blocked_probe(request) -> bool:
+    return (request.get("block_aligned", False)
+            and request["m"] % request.get("block_rows", 128) == 0)
+
+
+def _gmm_blocked_impl(request, lhs, rhs, group_sizes):
+    return _gmm_xla_blocked(lhs, rhs, group_sizes,
+                            request.get("block_rows", 128))
+
+
+def _gmm_ragged_probe(request) -> bool:
+    return hasattr(lax, "ragged_dot")
+
+
+def _gmm_ragged_impl(request, lhs, rhs, group_sizes):
     return lax.ragged_dot(lhs, rhs, group_sizes.astype(jnp.int32))
+
+
+def _sweep_key_fields(req):
+    return {"m": autotune.shape_bucket(req["m"]), "k": req["k"],
+            "n": req["n"]}
+
+
+def _sweep_candidates(req):
+    # Same VMEM-budget model as the runtime lookup's validate: an
+    # over-budget candidate could win the sweep (forced() bypasses
+    # validation) but would be rejected on every real call.
+    return [(tm, tn) for tm in (512, 256, 128) for tn in (512, 256, 128)
+            if _tile_bytes(tm, tn, req["k"])
+            <= tiling.DEFAULT_TILE_BUDGET_BYTES]
+
+
+def _sweep_run(req, choice) -> float:
+    m, k, n = req["m"], req["k"], req["n"]
+    E = int(req.get("num_groups", 8))
+    dtype = jnp.dtype(req.get("dtype", "bfloat16"))
+    key = jax.random.key(0)
+    lhs = jax.random.normal(key, (m, k), jnp.float32).astype(dtype)
+    rhs = jax.random.normal(key, (E, k, n), jnp.float32).astype(dtype)
+    sizes = jnp.full((E,), m // E, jnp.int32)
+    sizes = sizes.at[-1].add(m - int(m // E) * E)
+
+    def loss(lhs, rhs):
+        return jnp.sum(gmm(lhs, rhs, sizes).astype(jnp.float32))
+
+    fn = jax.jit(jax.grad(loss, argnums=(0, 1)))
+    return autotune.time_call(fn, lhs, rhs)
+
+
+registry.register_kernel(
+    "gmm.pallas", probe=_gmm_pallas_probe, impl=_gmm_pallas_impl,
+    fallback="gmm.xla_blocked", reference=_gmm_reference)
+registry.register_kernel(
+    "gmm.xla_blocked", probe=_gmm_blocked_probe, impl=_gmm_blocked_impl,
+    fallback="gmm.ragged", reference=_gmm_reference)
+registry.register_kernel(
+    "gmm.ragged", probe=_gmm_ragged_probe, impl=_gmm_ragged_impl,
+    fallback=None)
+autotune.register_sweep(
+    "gmm", key_fields=_sweep_key_fields, candidates=_sweep_candidates,
+    run=_sweep_run)

@@ -1,0 +1,42 @@
+"""Unified Pallas kernel substrate.
+
+One home for what every in-tree kernel used to carry separately:
+
+* ``tiling``   — shared tiling/masking/online-softmax helpers and the single
+  BlockSpec / grid-spec / CompilerParams construction path (lint rule L006
+  keeps raw construction out of the rest of the tree);
+* ``registry`` — the capability-probe + fallback registry that makes kernel
+  dispatch data-driven (the generalized splash -> flash -> SDPA chain);
+* ``autotune`` — persistent block-size autotuning per (kernel, shape-bucket,
+  dtype, topology) with the hand-tuned values as always-available defaults;
+* ``parity``   — the shared interpret-mode parity harness that checks every
+  registered kernel against its XLA reference.
+
+See docs/guides/kernels.md.
+"""
+
+from automodel_tpu.ops.kernel_lib import autotune, registry, tiling
+from automodel_tpu.ops.kernel_lib.registry import (
+    KernelSpec,
+    dispatch,
+    ensure_default_kernels,
+    fallback_chain,
+    get_kernel,
+    kernel_names,
+    register_kernel,
+    resolve,
+)
+
+__all__ = [
+    "KernelSpec",
+    "autotune",
+    "dispatch",
+    "ensure_default_kernels",
+    "fallback_chain",
+    "get_kernel",
+    "kernel_names",
+    "register_kernel",
+    "registry",
+    "resolve",
+    "tiling",
+]

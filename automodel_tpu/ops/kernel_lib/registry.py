@@ -1,0 +1,184 @@
+"""Capability-probe + fallback registry: data-driven kernel dispatch.
+
+Generalizes the hand-rolled splash -> flash -> SDPA chain that used to live
+as per-call-site ``try/except`` logic in ``ops/attention.py``: each kernel
+registers a :class:`KernelSpec` ``(name, probe, impl, fallback)`` and a
+call site resolves a request by walking the fallback chain until a probe
+accepts.  CPU / interpret / dryrun and TPU-generation differences are then
+a property of the PROBES, not of every caller.
+
+Contract:
+
+* ``probe(request) -> bool`` — pure availability/capability check against a
+  plain-dict request (static shapes, dtype, feature flags, sharding
+  context).  Probes must not raise for "unavailable" — return False.
+* ``impl(request, *args, **kwargs)`` — the kernel entry.  Impls look their
+  collaborators up at CALL time (module globals), so tests can monkeypatch
+  a kernel module and the registry follows.
+* ``fallback`` — the next rung's registered name; ``None`` ends the chain.
+* ``reference`` — optional XLA oracle with the same ``(request, *args)``
+  signature, consumed by the shared interpret-mode parity harness
+  (``kernel_lib/parity.py``).
+
+Kernel modules register their rungs at import; :func:`ensure_default_kernels`
+imports every in-tree kernel module (tolerating ImportError on old JAX by
+stubbing the rung so the chain stays walkable) and is idempotent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, List, Mapping, Optional
+
+Probe = Callable[[Mapping[str, Any]], bool]
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelSpec:
+    """One registered kernel rung."""
+
+    name: str                          # e.g. "attention.splash"
+    probe: Probe
+    impl: Callable[..., Any]
+    fallback: Optional[str] = None
+    reference: Optional[Callable[..., Any]] = None
+
+    @property
+    def kind(self) -> str:
+        """Kernel family — the dotted prefix ("attention", "gmm", ...)."""
+        return self.name.split(".", 1)[0]
+
+
+_REGISTRY: Dict[str, KernelSpec] = {}
+_LOCK = threading.Lock()
+_defaults_loaded = False
+
+
+def register_kernel(name: str, *, probe: Probe, impl: Callable,
+                    fallback: Optional[str] = None,
+                    reference: Optional[Callable] = None) -> KernelSpec:
+    """Register (or re-register: kernel modules may be reloaded) a rung."""
+    spec = KernelSpec(name=name, probe=probe, impl=impl, fallback=fallback,
+                      reference=reference)
+    with _LOCK:
+        _REGISTRY[name] = spec
+    return spec
+
+
+def register_stub(name: str, fallback: Optional[str] = None,
+                  reason: str = "unavailable") -> KernelSpec:
+    """A never-available rung standing in for a kernel module that failed
+    to import (old JAX): keeps the fallback chain walkable."""
+
+    def _probe(request) -> bool:
+        return False
+
+    def _impl(request, *args, **kwargs):
+        raise RuntimeError(f"kernel {name!r} is unavailable: {reason}")
+
+    with _LOCK:
+        if name in _REGISTRY:       # a real registration beat us to it
+            return _REGISTRY[name]
+    return register_kernel(name, probe=_probe, impl=_impl, fallback=fallback)
+
+
+def get_kernel(name: str) -> KernelSpec:
+    ensure_default_kernels()
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"no kernel registered under {name!r}; known: "
+            f"{sorted(_REGISTRY)}") from None
+
+
+def kernel_names() -> List[str]:
+    ensure_default_kernels()
+    return sorted(_REGISTRY)
+
+
+def fallback_chain(name: str) -> List[str]:
+    """The rung names walked for ``name``, head first."""
+    out, cur = [], name
+    while cur is not None:
+        spec = get_kernel(cur)
+        out.append(cur)
+        cur = spec.fallback
+        if cur in out:
+            raise RuntimeError(f"kernel fallback cycle at {cur!r}: {out}")
+    return out
+
+
+def resolve(name: str, request: Mapping[str, Any]) -> KernelSpec:
+    """First rung in ``name``'s fallback chain whose probe accepts
+    ``request``.  Raises RuntimeError when the chain is exhausted — chains
+    should end in an always-available anchor (SDPA, ragged_dot)."""
+    seen: List[str] = []
+    cur: Optional[str] = name
+    while cur is not None:
+        spec = get_kernel(cur)
+        seen.append(cur)
+        if spec.probe(request):
+            return spec
+        cur = spec.fallback
+        if cur in seen:
+            raise RuntimeError(f"kernel fallback cycle at {cur!r}: {seen}")
+    raise RuntimeError(
+        f"no kernel in the {name!r} chain accepted the request "
+        f"{dict(request)!r}; probed: {seen}")
+
+
+def dispatch(name: str, request: Mapping[str, Any], *args, **kwargs):
+    """Resolve and call in one step."""
+    return resolve(name, request).impl(request, *args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Default in-tree kernels
+# ---------------------------------------------------------------------------
+# (module, rung it registers, that rung's fallback — for the ImportError stub)
+_DEFAULT_KERNEL_MODULES = (
+    ("automodel_tpu.ops.ring_attention", "attention.ring",
+     "attention.splash"),
+    ("automodel_tpu.ops.splash_attention", "attention.splash",
+     "attention.flash"),
+    ("automodel_tpu.ops.flash_attention", "attention.flash",
+     "attention.sdpa"),
+    ("automodel_tpu.ops.attention", "attention.sdpa", None),
+    ("automodel_tpu.ops.linear_ce_kernel", "linear_ce.pallas",
+     "linear_ce.chunked"),
+    ("automodel_tpu.loss.linear_ce", "linear_ce.chunked", None),
+    ("automodel_tpu.ops.gmm_kernel", "gmm.pallas", "gmm.xla_blocked"),
+)
+
+
+def ensure_default_kernels() -> None:
+    """Import every in-tree kernel module once so their registrations run;
+    a module that cannot import on this JAX gets a stub rung instead, so
+    resolution falls through it exactly like a failing probe."""
+    global _defaults_loaded
+    if _defaults_loaded:
+        return
+    _defaults_loaded = True     # set first: kernel modules import us back
+    import importlib
+
+    import logging
+
+    for mod, rung, fallback in _DEFAULT_KERNEL_MODULES:
+        try:
+            importlib.import_module(mod)
+        except Exception as e:
+            # ImportError is the expected old-JAX shape, but upstream API
+            # drift can surface as AttributeError/TypeError at import —
+            # either way the chain must stay walkable past the dead rung
+            if not isinstance(e, ImportError):
+                logging.getLogger(__name__).warning(
+                    "kernel module %s failed to import (%s: %s); its rung "
+                    "%r is stubbed and dispatch falls through to %r",
+                    mod, type(e).__name__, e, rung, fallback)
+            register_stub(rung, fallback=fallback, reason=str(e))
+        else:
+            if rung not in _REGISTRY:   # module imported but didn't register
+                register_stub(rung, fallback=fallback,
+                              reason=f"{mod} registered no {rung!r}")

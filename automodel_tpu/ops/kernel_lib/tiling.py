@@ -1,0 +1,233 @@
+"""Shared tiling / masking / accumulation substrate for the in-tree Pallas
+kernels.
+
+Every kernel in ``automodel_tpu/ops`` (``flash_attention``,
+``splash_attention``, ``ring_attention``, ``linear_ce_kernel``,
+``gmm_kernel``) builds its blocks, grids and compiler params through this
+module — the ONE construction path the repo linter enforces (rule L006:
+raw ``pl.BlockSpec`` / grid-spec / compiler-params construction outside
+``ops/kernel_lib/`` is a finding).  Centralizing the path means:
+
+* block-size choices flow through the autotuner (``kernel_lib/autotune``)
+  with the hand-tuned values as the always-available defaults;
+* the VMEM-budgeted tile search (``fit_tile_pair``) and the legal-block
+  divisor pick (``pick_block``) exist once instead of per kernel;
+* the TPUCompilerParams -> CompilerParams rename stays absorbed in
+  ``utils/jax_compat.py`` with the raised 64 MB ``vmem_limit_bytes``
+  default applied uniformly (Mosaic's 16 MB default is far under physical
+  VMEM and failed real tile choices — see ``linear_ce_kernel``'s history);
+* the blockwise-attention math (online-softmax merge, tile validity /
+  skip predicates) is shared between the ring kernel and any future
+  blockwise consumer instead of re-derived.
+
+Constants follow TPU hardware: the lane dim is always 128; MXU-friendly
+block edges are >= 256 (128-edge blocks measured ~30% step-time penalty at
+Llama-1B shapes on v5e).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+LANE = 128                 # last-dim tile width on every TPU generation
+MIN_BLOCK = 128            # minimum legal Pallas block edge
+SEQ_ALIGN = 256            # pad sequences so block edges stay MXU-friendly
+DEFAULT_VMEM_LIMIT_BYTES = 64 * 1024 * 1024
+DEFAULT_TILE_BUDGET_BYTES = 24 * 1024 * 1024
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+# ---------------------------------------------------------------------------
+# The single CompilerParams / BlockSpec / grid-spec construction path
+# ---------------------------------------------------------------------------
+def compiler_params(*, vmem_limit_bytes: int = DEFAULT_VMEM_LIMIT_BYTES,
+                    **kwargs):
+    """Pallas TPU compiler params with the framework-wide raised VMEM
+    ceiling.  Rides ``utils/jax_compat.pallas_tpu_compiler_params`` (the
+    L001-sanctioned home of the TPUCompilerParams -> CompilerParams rename
+    shim)."""
+    from automodel_tpu.utils.jax_compat import pallas_tpu_compiler_params
+
+    return pallas_tpu_compiler_params(
+        vmem_limit_bytes=vmem_limit_bytes, **kwargs)
+
+
+def block_spec(block_shape=None, index_map=None, *, memory_space=None):
+    """``pl.BlockSpec`` construction point (L006).  ``memory_space=None``
+    keeps Pallas' default placement."""
+    from jax.experimental import pallas as pl
+
+    if memory_space is None:
+        return pl.BlockSpec(block_shape, index_map)
+    return pl.BlockSpec(block_shape, index_map, memory_space=memory_space)
+
+
+def vmem_block_spec(block_shape, index_map):
+    """BlockSpec pinned to VMEM — the common case for kernel operands."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return block_spec(block_shape, index_map, memory_space=pltpu.VMEM)
+
+
+def prefetch_grid_spec(*, num_scalar_prefetch: int, grid, in_specs,
+                       out_specs, scratch_shapes=()):
+    """``pltpu.PrefetchScalarGridSpec`` construction point (L006): scalar
+    arrays ride ahead of the grid so BlockSpec index maps can steer DMAs
+    per work item (the grouped-matmul schedule pattern)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=num_scalar_prefetch, grid=grid,
+        in_specs=in_specs, out_specs=out_specs,
+        scratch_shapes=list(scratch_shapes))
+
+
+# ---------------------------------------------------------------------------
+# Block / tile sizing
+# ---------------------------------------------------------------------------
+def pick_block(n: int,
+               candidates: Sequence[int] = (1024, 512, 256, 128)) -> int:
+    """Largest candidate block edge that divides ``n`` (descending order);
+    ``n`` itself when none does (caller has padded or accepts the edge)."""
+    for b in candidates:
+        if n % b == 0:
+            return b
+    return n
+
+
+def fit_tile_pair(
+    rows: int,
+    row_candidates: Sequence[int],
+    col_candidates: Sequence[int],
+    bytes_fn: Callable[[int, int], int],
+    budget: int = DEFAULT_TILE_BUDGET_BYTES,
+    floor: Tuple[int, int] = (MIN_BLOCK, MIN_BLOCK),
+) -> Tuple[int, int]:
+    """Largest (rows, cols) tile pair whose VMEM working set — as modelled
+    by ``bytes_fn(tm, tn)`` (double-buffered operand blocks + fp32
+    accumulators, kernel-specific) — fits ``budget``.
+
+    Grid steps have fixed Mosaic overhead (~5 us), so bigger tiles sit
+    closer to the MXU roofline; tails are masked/padded in-kernel, so only
+    the 128 lane constrains shapes.  The budget deliberately undershoots
+    the ``vmem_limit_bytes`` ceiling (Mosaic's own pipeline buffering is
+    not in the caller's estimate, ~2x)."""
+    best = floor
+    row_cap = -(-max(rows, 1) // MIN_BLOCK) * MIN_BLOCK
+    for tm in row_candidates:
+        if tm > row_cap:
+            continue
+        for tn in col_candidates:
+            if bytes_fn(tm, tn) <= budget and tm * tn > best[0] * best[1]:
+                best = (tm, tn)
+    return best
+
+
+def ceil_pad(x, mult: int, axis: int, value=0.0):
+    """Pad ``axis`` up to the next multiple of ``mult`` with ``value``."""
+    pad = (-x.shape[axis]) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+# ---------------------------------------------------------------------------
+# Online-softmax accumulation (flash-style, shared by blockwise attention)
+# ---------------------------------------------------------------------------
+def rowscale(x):
+    """Broadcast a per-row factor [B, Hk, G, Sq] onto an accumulation
+    tensor [B, Sq, Hk, G, D]."""
+    return x[..., None].transpose(0, 3, 1, 2, 4)
+
+
+def combine_online_softmax(acc, m_run, s_run, o_b, m_b, s_b):
+    """Numerically-stable merge of a new partial attention block into a
+    running (acc, max, sumexp) state.
+
+    ``acc``/``o_b``: unnormalized outputs [B, Sq, Hk, G, D] (fp32);
+    ``m_run``/``s_run``/``m_b``/``s_b``: row max / sumexp [B, Hk, G, Sq].
+    Returns the merged ``(acc, m_new, s_new)``.
+    """
+    m_new = jnp.maximum(m_run, m_b)
+    alpha = jnp.exp(m_run - m_new)                  # rescale old state
+    beta = jnp.exp(m_b - m_new)
+    acc = acc * rowscale(alpha) + o_b * rowscale(beta)
+    return acc, m_new, s_run * alpha + s_b * beta
+
+
+# ---------------------------------------------------------------------------
+# Tile masking: validity + static-structure skip predicates
+# ---------------------------------------------------------------------------
+def tile_skip_predicate(q_pos, kv_pos, sq_min, sq_max, skv, *,
+                        causal: bool,
+                        local_window_size=None,
+                        q_pos_min=None, q_pos_max=None):
+    """True when a (q tile, kv tile) pair is PROVABLY all-masked, from tile
+    min/max positions and segment bounds alone (any one condition
+    suffices):
+
+    * causal and the earliest kv position is after the latest q position
+      (wholly-future tile — the ~2x causal saving);
+    * sliding window and the latest kv position is already out of every
+      q's trailing window;
+    * the kv tile's segment-id range cannot intersect the q tile's range
+      (also catches all-padding tiles when pads carry out-of-range
+      sentinel segments).
+
+    Skipping stays SOUND under padding sentinels that only loosen the
+    bounds (conservative on ragged tails).
+    """
+    if q_pos_max is None:
+        q_pos_max = jnp.max(q_pos)
+    if q_pos_min is None:
+        q_pos_min = jnp.min(q_pos)
+    skip = jnp.min(skv) > sq_max
+    skip |= jnp.max(skv) < sq_min
+    if causal:
+        skip |= jnp.min(kv_pos) > q_pos_max
+    if local_window_size is not None:
+        skip |= jnp.max(kv_pos) <= q_pos_min - local_window_size
+    return skip
+
+
+def tile_valid_mask(q_pos, kv_pos, sqc, skvc, *, causal: bool,
+                    local_window_size=None, use_segs: bool,
+                    batch: int, cq: int, ckv: int):
+    """Per-element validity [B, cq, ckv] of one q tile x kv tile from
+    position / segment arithmetic — no [Sq, Skv] mask ever materializes.
+
+    Without segment ids, kv pads are recognized by negative sentinel
+    segments (``skvc >= 0`` keeps real data); with them, the framework
+    convention applies (segment 0 = padding, never attended).
+    """
+    valid = jnp.ones((batch, cq, ckv), bool)
+    if causal:
+        valid &= (q_pos[:, None] >= kv_pos[None, :])[None]
+    if local_window_size is not None:
+        valid &= (q_pos[:, None] - kv_pos[None, :]
+                  < local_window_size)[None]
+    if use_segs:
+        valid &= sqc[:, :, None] == skvc[:, None, :]
+        valid &= (skvc != 0)[:, None, :]
+    else:
+        valid &= (skvc >= 0)[:, None, :]     # pad tiles only
+    return valid
+
+
+def mask_tail_columns(logits, tile_index, n_actual: int, neg: float = -1e30):
+    """Mask columns at/past the true column count of a [TM, TV] tile with
+    ``neg`` so they vanish from max / exp / picked reductions (vocab-tail
+    masking: V only needs lane alignment, not tile alignment)."""
+    import jax
+
+    tm, tv = logits.shape
+    if n_actual % tv:
+        gcol = tile_index * tv + jax.lax.broadcasted_iota(
+            jnp.int32, (tm, tv), 1)
+        logits = jnp.where(gcol < n_actual, logits, neg)
+    return logits

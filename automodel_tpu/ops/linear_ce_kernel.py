@@ -45,24 +45,24 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from automodel_tpu.utils.jax_compat import pallas_tpu_compiler_params
+from automodel_tpu.ops.kernel_lib import autotune, registry, tiling
 
 # Pallas interpret mode: lets the CPU test suite execute the real kernel
 # logic (tests monkeypatch this, mirroring ops/splash_attention.py).
 _INTERPRET = False
 
-_LANE = 128
+_LANE = tiling.LANE
 _NEG_INF = -1e30
 
 # Mosaic's DEFAULT scoped-vmem budget is 16 MB, far under v5e's physical
 # 128 MB — tile choices near the default ceiling failed to compile at some
-# token counts (the pipeline's own buffering isn't in our estimate).  Raising
-# the kernel limit gives the static tile table real headroom.  The params
-# class rides the TPUCompilerParams -> CompilerParams rename shim so this
-# module (and everything importing it: loss/linear_ce.py, bench.py) loads on
-# both sides of it.
-_COMPILER_PARAMS = pallas_tpu_compiler_params(
-    vmem_limit_bytes=64 * 1024 * 1024)
+# token counts (the pipeline's own buffering isn't in our estimate).  The
+# substrate default raises the kernel limit to 64 MB, giving the static
+# tile table real headroom; the params construction rides the
+# TPUCompilerParams -> CompilerParams rename shim via kernel_lib.tiling, so
+# this module (and everything importing it: loss/linear_ce.py, bench.py)
+# loads on both sides of it.
+_COMPILER_PARAMS = tiling.compiler_params()
 
 
 def linear_ce_kernel_available(n_tokens: int, hidden: int, vocab: int) -> bool:
@@ -77,29 +77,45 @@ def linear_ce_kernel_available(n_tokens: int, hidden: int, vocab: int) -> bool:
         return False
 
 
+def _tile_bytes(tm: int, tv: int, hidden: int,
+                acc_bytes_per_row: int = 0,
+                acc_bytes_per_col: int = 0) -> int:
+    """VMEM working set of one (TM, TV) tile pair: double-buffered h and w
+    tiles + one f32 logits tile + any f32 accumulator the kernel keeps per
+    row/col.  ONE byte model — shared by the runtime tile search/validate
+    AND the sweep's candidate filter, so an estimate change can never let
+    the sweep persist a winner the runtime would reject."""
+    return (2 * tm * hidden * 2 + 2 * hidden * tv * 2
+            + tm * tv * 4 + tm * acc_bytes_per_row
+            + tv * acc_bytes_per_col)
+
+
 def _tiles(n_tokens: int, hidden: int, vocab: int,
            acc_bytes_per_row: int = 0, acc_bytes_per_col: int = 0,
-           budget: int = 24 * 1024 * 1024) -> Tuple[int, int]:
-    """(TM rows, TV vocab cols): the largest tile pair whose VMEM working set
-    (double-buffered h and w tiles + one f32 logits tile + any f32
-    accumulator the kernel keeps per row/col) fits the budget.  Grid steps
-    have fixed Mosaic overhead (~5 us), so bigger tiles = closer to the MXU
-    roofline (tail tiles are masked in-kernel, so no divisibility constraint
-    beyond the 128 lane).  The budget works WITH the raised 64 MB
-    ``vmem_limit_bytes`` (the estimate undercounts Mosaic's own pipeline
-    buffering by ~2x); (1024, 512) everywhere measured 262 ms/iter for the
-    Llama-1B value_and_grad vs 281 ms for the 16 MB-era conservative tiles."""
-    best = (128, 128)
-    for tm in (1024, 512, 256, 128):
-        if tm > ((n_tokens + 127) // 128) * 128:
-            continue
-        for tv in (512, 128):
-            use = (2 * tm * hidden * 2 + 2 * hidden * tv * 2
-                   + tm * tv * 4 + tm * acc_bytes_per_row
-                   + tv * acc_bytes_per_col)
-            if use <= budget and tm * tv > best[0] * best[1]:
-                best = (tm, tv)
-    return best
+           budget: int = tiling.DEFAULT_TILE_BUDGET_BYTES) -> Tuple[int, int]:
+    """(TM rows, TV vocab cols): the largest tile pair whose
+    ``_tile_bytes`` working set fits the budget (``tiling.fit_tile_pair``).
+    Grid steps have fixed Mosaic overhead (~5 us), so bigger tiles =
+    closer to the MXU roofline (tail tiles are masked in-kernel, so no
+    divisibility constraint beyond the 128 lane).  The budget works WITH
+    the raised 64 MB ``vmem_limit_bytes`` (the estimate undercounts
+    Mosaic's own pipeline buffering by ~2x); (1024, 512) everywhere
+    measured 262 ms/iter for the Llama-1B value_and_grad vs 281 ms for the
+    16 MB-era conservative tiles.  A persisted autotune winner (kernel key
+    ``"linear_ce"``) overrides the budget search when it fits THIS call's
+    accumulator budget."""
+    def use(tm: int, tv: int) -> int:
+        return _tile_bytes(tm, tv, hidden, acc_bytes_per_row,
+                           acc_bytes_per_col)
+
+    default = tiling.fit_tile_pair(
+        n_tokens, (1024, 512, 256, 128), (512, 128), use, budget)
+    fields = {"t": autotune.shape_bucket(n_tokens), "h": hidden, "v": vocab}
+    return autotune.lookup(
+        "linear_ce", fields, default,
+        validate=lambda c: (len(c) == 2 and c[0] % _LANE == 0
+                            and c[1] % _LANE == 0
+                            and use(c[0], c[1]) <= budget))
 
 
 def _masked_logits(h_ref, w_ref, j, v_actual):
@@ -107,11 +123,7 @@ def _masked_logits(h_ref, w_ref, j, v_actual):
     -inf so they vanish from max/exp/picked."""
     logits = jnp.dot(h_ref[...], w_ref[...],
                      preferred_element_type=jnp.float32)
-    tm, tv = logits.shape
-    if v_actual % tv:
-        gcol = j * tv + jax.lax.broadcasted_iota(jnp.int32, (tm, tv), 1)
-        logits = jnp.where(gcol < v_actual, logits, _NEG_INF)
-    return logits
+    return tiling.mask_tail_columns(logits, j, v_actual, neg=_NEG_INF)
 
 
 # ---------------------------------------------------------------------------
@@ -169,18 +181,13 @@ def _fwd_pallas(h: jnp.ndarray, w: jnp.ndarray, labels: jnp.ndarray,
         functools.partial(_fwd_kernel, v_actual=v),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((tm, 1), lambda i, j: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tm, hid), lambda i, j: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((hid, tv), lambda i, j: (0, j),
-                         memory_space=pltpu.VMEM),
+            tiling.vmem_block_spec((tm, 1), lambda i, j: (i, 0)),
+            tiling.vmem_block_spec((tm, hid), lambda i, j: (i, 0)),
+            tiling.vmem_block_spec((hid, tv), lambda i, j: (0, j)),
         ],
         out_specs=[
-            pl.BlockSpec((tm, 1), lambda i, j: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tm, 1), lambda i, j: (i, 0),
-                         memory_space=pltpu.VMEM),
+            tiling.vmem_block_spec((tm, 1), lambda i, j: (i, 0)),
+            tiling.vmem_block_spec((tm, 1), lambda i, j: (i, 0)),
         ],
         out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((tm, 1), jnp.float32)] * 3,
@@ -276,15 +283,12 @@ def _bwd_pallas(h, w, labels, lse, dlse, dpick):
     dh = pl.pallas_call(
         functools.partial(_bwd_dh_kernel, v_actual=v),
         grid=(t // tm, wp.shape[1] // tv),
-        in_specs=[pl.BlockSpec((tm, 1), col1, memory_space=pltpu.VMEM)] * 4
+        in_specs=[tiling.vmem_block_spec((tm, 1), col1)] * 4
         + [
-            pl.BlockSpec((tm, hid), lambda i, j: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((hid, tv), lambda i, j: (0, j),
-                         memory_space=pltpu.VMEM),
+            tiling.vmem_block_spec((tm, hid), lambda i, j: (i, 0)),
+            tiling.vmem_block_spec((hid, tv), lambda i, j: (0, j)),
         ],
-        out_specs=pl.BlockSpec((tm, hid), lambda i, j: (i, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=tiling.vmem_block_spec((tm, hid), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((t, hid), h.dtype),
         scratch_shapes=[pltpu.VMEM((tm, hid), jnp.float32)],
         cost_estimate=pl.CostEstimate(
@@ -301,15 +305,12 @@ def _bwd_pallas(h, w, labels, lse, dlse, dpick):
     dw = pl.pallas_call(
         functools.partial(_bwd_dw_kernel, v_actual=v),
         grid=(wp.shape[1] // tv, t // tm),
-        in_specs=[pl.BlockSpec((tm, 1), swap, memory_space=pltpu.VMEM)] * 4
+        in_specs=[tiling.vmem_block_spec((tm, 1), swap)] * 4
         + [
-            pl.BlockSpec((tm, hid), lambda j, i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((hid, tv), lambda j, i: (0, j),
-                         memory_space=pltpu.VMEM),
+            tiling.vmem_block_spec((tm, hid), lambda j, i: (i, 0)),
+            tiling.vmem_block_spec((hid, tv), lambda j, i: (0, j)),
         ],
-        out_specs=pl.BlockSpec((hid, tv), lambda j, i: (0, j),
-                               memory_space=pltpu.VMEM),
+        out_specs=tiling.vmem_block_spec((hid, tv), lambda j, i: (0, j)),
         out_shape=jax.ShapeDtypeStruct((hid, wp.shape[1]), w.dtype),
         scratch_shapes=[pltpu.VMEM((hid, tv), jnp.float32)],
         cost_estimate=pl.CostEstimate(
@@ -401,3 +402,64 @@ def _bwd(bwd_mode, res, cot):
 
 lse_and_pick.defvjp(lambda h, w, labels, bwd_mode: _fwd(h, w, labels, bwd_mode),
                     _bwd)
+
+
+# ---------------------------------------------------------------------------
+# Registry rung + autotune adapter
+# ---------------------------------------------------------------------------
+def _lce_probe(request) -> bool:
+    return linear_ce_kernel_available(request["t"], request["h"],
+                                      request["v"])
+
+
+def _lce_impl(request, h, w, labels):
+    return lse_and_pick(h, w, labels, request.get("bwd_mode", "pallas"))
+
+
+def _sweep_key_fields(req):
+    return {"t": autotune.shape_bucket(req["t"]), "h": req["h"],
+            "v": req["v"]}
+
+
+def _sweep_candidates(req):
+    # Only candidates every runtime lookup can accept: the strictest
+    # role's accumulator (dh keeps a [TM, H] fp32 scratch) must fit the
+    # budget, else the persisted "winner" would be validate-rejected on
+    # each call and the sweep's cost never pays out.
+    hd = req["h"]
+    out = []
+    for tm in (1024, 512, 256, 128):
+        for tv in (512, 256, 128):
+            if (tm <= -(-req["t"] // _LANE) * _LANE
+                    and _tile_bytes(tm, tv, hd, acc_bytes_per_row=hd * 4)
+                    <= tiling.DEFAULT_TILE_BUDGET_BYTES):
+                out.append((tm, tv))
+    return out
+
+
+def _sweep_run(req, choice) -> float:
+    t, hd, v = req["t"], req["h"], req["v"]
+    dtype = jnp.dtype(req.get("dtype", "bfloat16"))
+    key = jax.random.key(0)
+    h = jax.random.normal(key, (t, hd), jnp.float32).astype(dtype)
+    w = (jax.random.normal(key, (hd, v), jnp.float32) * 0.05).astype(dtype)
+    labels = jax.random.randint(key, (t,), 0, v, jnp.int32)
+
+    def loss(h, w):
+        lse, pick = lse_and_pick(h, w, labels, "pallas")
+        return jnp.sum(lse - pick)
+
+    fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1)))
+    return autotune.time_call(fn, h, w)
+
+
+from automodel_tpu.ops.kernel_lib.parity import (  # noqa: E402
+    dense_lse_pick_reference,
+)
+
+registry.register_kernel(
+    "linear_ce.pallas", probe=_lce_probe, impl=_lce_impl,
+    fallback="linear_ce.chunked", reference=dense_lse_pick_reference)
+autotune.register_sweep(
+    "linear_ce", key_fields=_sweep_key_fields, candidates=_sweep_candidates,
+    run=_sweep_run)

@@ -18,10 +18,18 @@ across the ring; ``contiguous`` keeps the naive one-run-per-shard slicing
 (shard 0 nearly idle under a causal mask, shard cp-1 doing cp blocks).
 
 Tile skipping: the inner blockwise attention computes each kv tile's
-validity from tile min/max position and segment bounds and SKIPS
-wholly-masked tiles with ``lax.cond`` — a causal ring does ~half the FLOPs
-of the mask-to-zero formulation, and with the zig-zag layout that saving is
+validity from tile min/max position and segment bounds
+(``kernel_lib/tiling.tile_skip_predicate``) and SKIPS wholly-masked tiles
+with ``lax.cond`` — a causal ring does ~half the FLOPs of the
+mask-to-zero formulation, and with the zig-zag layout that saving is
 identical on every shard instead of concentrated on the early ones.
+
+This module registers the ``attention.ring`` rung at the HEAD of the
+attention fallback chain (``kernel_lib/registry``): an active sharding
+context with cp > 1 takes unconditional precedence, because under the
+zig-zag layout any fallback that assumes arange token order (SDPA's
+built-in causal mask) would be silently wrong on a permuted stream.  Tile
+edges route through the substrate autotuner (kernel key ``"ring"``).
 """
 
 from __future__ import annotations
@@ -33,6 +41,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from automodel_tpu.ops.kernel_lib import autotune, registry, tiling
+from automodel_tpu.ops.kernel_lib.tiling import ceil_pad as _ceil_pad
+
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 # Position sentinel for kv tile padding: any causal query masks it (and it
@@ -41,20 +52,22 @@ _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 _PAD_POS = jnp.iinfo(jnp.int32).max // 2
 
 
-# Tile edges for the blockwise inner attention.  Peak transient memory per
-# tile is B*Hk*G*_CQ*_CKV fp32 logits (64 MiB at 32 heads) independent of
-# the shard's sequence length — naive [S, S] logits would be 8.6 GiB at
-# S_local=8k, an OOM before long context even starts.
+# Default tile edges for the blockwise inner attention.  Peak transient
+# memory per tile is B*Hk*G*cq*ckv fp32 logits (64 MiB at 32 heads)
+# independent of the shard's sequence length — naive [S, S] logits would be
+# 8.6 GiB at S_local=8k, an OOM before long context even starts.
 _CQ, _CKV = 512, 1024
 
 
-def _ceil_pad(x, mult, axis, value=0.0):
-    pad = (-x.shape[axis]) % mult
-    if pad == 0:
-        return x
-    widths = [(0, 0)] * x.ndim
-    widths[axis] = (0, pad)
-    return jnp.pad(x, widths, constant_values=value)
+def _tile_plan(sq: int, skv: int, dtype) -> Tuple[int, int]:
+    """(cq, ckv) inner tile edges: hand-tuned default, autotune override.
+    Any pair is legal (ragged tails are padded), so no divisibility
+    validation is needed."""
+    default = (min(_CQ, sq), min(_CKV, skv))
+    fields = autotune.attention_sweep_key_fields(
+        {"q_seq": sq, "kv_seq": skv, "dtype": str(dtype)})
+    return autotune.lookup("ring", fields, default,
+                           validate=lambda c: len(c) == 2 and min(c) >= 1)
 
 
 def _shard_positions(shard_index, s_local: int, cp: int,
@@ -87,15 +100,15 @@ def _block_attend(q, k, v, *, q_positions=None, kv_positions=None, causal,
     ``q_positions`` [Sq] / ``kv_positions`` [Skv] are explicit per-token
     global positions (None = arange): zig-zag shards hold NON-CONTIGUOUS
     positions, so scalar offset arithmetic cannot describe them.  Tile masks
-    are computed from position/segment arithmetic on the fly — no [Sq, Skv]
-    mask or logits tensor ever materializes — and a kv tile whose min/max
-    position and segment bounds prove it wholly masked is SKIPPED with
-    ``lax.cond`` (state passes through untouched) instead of computed and
-    zeroed.
+    are computed from position/segment arithmetic on the fly
+    (``tiling.tile_valid_mask``) — no [Sq, Skv] mask or logits tensor ever
+    materializes — and a kv tile that ``tiling.tile_skip_predicate`` proves
+    wholly masked is SKIPPED with ``lax.cond`` (state passes through
+    untouched) instead of computed and zeroed.
     """
     B, Sq, Hk, G, D = q.shape
     Skv = k.shape[1]
-    cq, ckv = min(_CQ, Sq), min(_CKV, Skv)
+    cq, ckv = _tile_plan(Sq, Skv, q.dtype)
 
     qp = _ceil_pad(q, cq, 1)
     kp = _ceil_pad(k, ckv, 1)
@@ -148,21 +161,11 @@ def _block_attend(q, k, v, *, q_positions=None, kv_positions=None, causal,
             kc, vc, skvc, kv_pos = xs2
 
             # --- static-structure tile skip ------------------------------
-            # A tile is provably all-masked when (any one suffices):
-            #   * causal and its EARLIEST kv position is after the LATEST
-            #     q position (wholly-future tile — the ~2x causal saving);
-            #   * sliding window and its LATEST kv position is already out
-            #     of every q's trailing window;
-            #   * its segment-id range cannot intersect the q tile's range
-            #     (also catches all-padding tiles: kv pads are -2, below
-            #     every real segment).
-            skip = jnp.min(skvc) > sq_max
-            skip |= jnp.max(skvc) < sq_min
-            if causal:
-                skip |= jnp.min(kv_pos) > q_pos_max
-            if local_window_size is not None:
-                skip |= jnp.max(kv_pos) <= q_pos_min - local_window_size
             # (skvc bounds span all batch rows: conservative but sound.)
+            skip = tiling.tile_skip_predicate(
+                q_pos, kv_pos, sq_min, sq_max, skvc, causal=causal,
+                local_window_size=local_window_size,
+                q_pos_min=q_pos_min, q_pos_max=q_pos_max)
 
             def compute(state):
                 acc, m_run, s_run, n_exec = state
@@ -174,17 +177,10 @@ def _block_attend(q, k, v, *, q_positions=None, kv_positions=None, causal,
                     # matches SDPA's cap semantics exactly.
                     logits = logits_soft_cap * jnp.tanh(
                         logits / logits_soft_cap)
-                valid = jnp.ones((B, cq, ckv), bool)
-                if causal:
-                    valid &= (q_pos[:, None] >= kv_pos[None, :])[None]
-                if local_window_size is not None:
-                    valid &= (q_pos[:, None] - kv_pos[None, :]
-                              < local_window_size)[None]
-                if use_segs:
-                    valid &= sqc[:, :, None] == skvc[:, None, :]
-                    valid &= (skvc != 0)[:, None, :]
-                else:
-                    valid &= (skvc >= 0)[:, None, :]     # pad tiles only
+                valid = tiling.tile_valid_mask(
+                    q_pos, kv_pos, sqc, skvc, causal=causal,
+                    local_window_size=local_window_size, use_segs=use_segs,
+                    batch=B, cq=cq, ckv=ckv)
                 logits = jnp.where(valid[:, None, None], logits, _NEG_INF)
                 m_b = jnp.maximum(jnp.max(logits, -1), -1e30)
                 p = jnp.exp(logits - m_b[..., None])
@@ -192,12 +188,9 @@ def _block_attend(q, k, v, *, q_positions=None, kv_positions=None, causal,
                 s_b = jnp.sum(p, -1)
                 o_b = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(vc.dtype), vc
                                  ).astype(jnp.float32)
-                m_new = jnp.maximum(m_run, m_b)
-                alpha = jnp.exp(m_run - m_new)
-                beta = jnp.exp(m_b - m_new)
-                acc = acc * alpha[..., None].transpose(0, 3, 1, 2, 4) \
-                    + o_b * beta[..., None].transpose(0, 3, 1, 2, 4)
-                return (acc, m_new, s_run * alpha + s_b * beta, n_exec + 1)
+                acc, m_new, s_new = tiling.combine_online_softmax(
+                    acc, m_run, s_run, o_b, m_b, s_b)
+                return (acc, m_new, s_new, n_exec + 1)
 
             return lax.cond(skip, lambda s: s, compute, state), None
 
@@ -263,13 +256,8 @@ def ring_attention(
             causal=causal, seg_q=segment_ids, seg_kv=seg_t,
             local_window_size=local_window_size,
             logits_soft_cap=logits_soft_cap)
-        m_new = jnp.maximum(m_run, m_b)
-        alpha = jnp.exp(m_run - m_new)                  # rescale old acc
-        beta = jnp.exp(m_b - m_new)
-        acc = acc * alpha[..., None].transpose(0, 3, 1, 2, 4) \
-            + out_b * beta[..., None].transpose(0, 3, 1, 2, 4)
-        s_run = s_run * alpha + s_b * beta
-        return acc, m_new, s_run
+        return tiling.combine_online_softmax(
+            acc, m_run, s_run, out_b, m_b, s_b)
 
     def body(carry, t):
         k_t, v_t, seg_t, *state = carry
@@ -298,7 +286,7 @@ def ring_attention(
             tuple(state), k_f, v_f, seg_f, cp - 1)
 
     denom = jnp.maximum(s_run, 1e-30)                   # [B,Hk,G,Sq]
-    out = acc / denom[..., None].transpose(0, 3, 1, 2, 4)
+    out = acc / tiling.rowscale(denom)
     return out.reshape(B, S, Hq, D).astype(q.dtype)
 
 
@@ -343,3 +331,75 @@ def sharded_ring_attention(
     return shard_map(
         wrapped, mesh=mesh, in_specs=(qspec, qspec, qspec, sspec),
         out_specs=qspec, check_vma=False)(q, k, v, segment_ids)
+
+
+# ---------------------------------------------------------------------------
+# Registry rung + autotune adapter
+# ---------------------------------------------------------------------------
+def _attention_probe(request) -> bool:
+    # context parallelism takes UNCONDITIONAL precedence: windows and soft
+    # caps are both applied per tile inside the ring (position arithmetic /
+    # tanh before the online softmax), so no cp>1 traffic ever falls
+    # through to a path that would assume arange token order — under the
+    # zig-zag layout SDPA's built-in causal mask would be silently wrong.
+    return bool(request.get("cp_active"))
+
+
+def _attention_impl(request, q, k, v, *, causal=True, segment_ids=None,
+                    attention_mask=None, scale=None, logits_soft_cap=None,
+                    local_window_size=None):
+    from automodel_tpu.ops.attention import fold_padding_into_segments
+
+    seg = fold_padding_into_segments(q.shape[:2], segment_ids,
+                                     attention_mask)
+    return sharded_ring_attention(
+        q, k, v, request["mesh"], causal=causal, segment_ids=seg,
+        scale=scale, local_window_size=local_window_size,
+        logits_soft_cap=logits_soft_cap, layout=request.get("cp_layout"))
+
+
+def _sweep_key_fields(req):
+    return autotune.attention_sweep_key_fields(req)
+
+
+def _sweep_candidates(req):
+    out = []
+    for cq in (1024, 512, 256):
+        for ckv in (1024, 512):
+            if cq <= req["q_seq"] and ckv <= req["kv_seq"]:
+                out.append((cq, ckv))
+    return out or [(min(512, req["q_seq"]), min(1024, req["kv_seq"]))]
+
+
+def _sweep_run(req, choice) -> float:
+    # single-device timing of the blockwise inner attention (the per-ring-
+    # step unit of work); the ppermute rotation is tile-size independent
+    B = int(req.get("batch", 1))
+    S, Skv = req["q_seq"], req["kv_seq"]
+    Hq = int(req.get("num_q_heads", 8))
+    Hk = int(req.get("num_kv_heads", Hq))
+    G, D = Hq // Hk, req["head_dim"]
+    dtype = jnp.dtype(req.get("dtype", "bfloat16"))
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (B, S, Hk, G, D), jnp.float32).astype(dtype)
+    k = jax.random.normal(key, (B, Skv, Hk, D), jnp.float32).astype(dtype)
+    v = jax.random.normal(key, (B, Skv, Hk, D), jnp.float32).astype(dtype)
+
+    def loss(q, k, v):
+        out, m, s = _block_attend(
+            q, k, v, causal=bool(req.get("causal", True)),
+            seg_q=None, seg_kv=None)
+        return jnp.sum(out) + jnp.sum(m) + jnp.sum(s)
+
+    fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    return autotune.time_call(fn, q, k, v)
+
+
+from automodel_tpu.ops.kernel_lib.parity import sdpa_reference  # noqa: E402
+
+registry.register_kernel(
+    "attention.ring", probe=_attention_probe, impl=_attention_impl,
+    fallback="attention.splash", reference=sdpa_reference)
+autotune.register_sweep(
+    "ring", key_fields=_sweep_key_fields, candidates=_sweep_candidates,
+    run=_sweep_run)

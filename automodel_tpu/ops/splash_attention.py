@@ -14,6 +14,18 @@ _transformers/auto_model.py:50-144``).  Advantages over
 * mask structure is processed host-side once per shape and skipped blocks
   are never executed (causal = ~2x fewer FLOPs, exactly).
 
+Block sizes route through the substrate autotuner (``kernel_lib/autotune``,
+kernel key ``"splash"``) with a LAYOUT-AWARE default: a partially-masked
+block (the causal diagonal, segment boundaries) still executes every
+``block_kv_compute`` sub-block — masked halves and all — so the wasted
+compute is ~``block_kv/S`` of the exact causal FLOPs.  At short S big
+blocks win (grid overhead dominates); at long S the diagonal waste does:
+1024-edge blocks at S=16k burn ~6.25% extra MXU time (the documented
+``long_context_16k`` bench gap), so causal/windowed masks at
+``S >= _DIAG_FINE_MIN_SEQ`` cap the edge at ``_DIAG_FINE_BLOCK`` (halving
+the waste), and the autotuner can refine further per (shape, dtype,
+topology).
+
 Segment ids (packed sequences) and padding masks use the framework-wide
 convention: pad positions get segment 0 (``ops/attention.py:
 fold_padding_into_segments``).
@@ -22,13 +34,24 @@ fold_padding_into_segments``).
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from automodel_tpu.ops.kernel_lib import autotune, registry, tiling
+
 _BLOCK = 128      # minimum legal splash block edge
-_SEQ_ALIGN = 256  # pad sequences so block edges stay >= 256 (MXU-friendly)
+_SEQ_ALIGN = tiling.SEQ_ALIGN  # pad sequences so block edges stay >= 256
+
+# Layout-aware diagonal tiling: below this sequence length the largest
+# legal block edge wins (Mosaic grid overhead dominates); at/above it the
+# causal-diagonal partial-block waste (~edge/S of the exact causal FLOPs)
+# dominates, so the edge is capped.  512 halves the 16k-context waste
+# (6.25% -> 3.1%) while staying on the >=256 MXU-friendly side the repo's
+# v5e measurements established (128-edge blocks cost ~30%).
+_DIAG_FINE_MIN_SEQ = 8192
+_DIAG_FINE_BLOCK = 512
 
 # Pallas interpret mode: lets the CPU test suite execute the real kernel
 # logic (tests monkeypatch this; the dispatcher never routes CPU traffic
@@ -50,19 +73,44 @@ def splash_attention_available(q_seq: int, kv_seq: int, head_dim: int) -> bool:
 
 
 def _pick_block(n: int) -> int:
-    for b in (1024, 512, 256, 128):
-        if n % b == 0:
-            return b
-    return n
+    return tiling.pick_block(n, (1024, 512, 256, 128))
+
+
+def _block_plan(q_seq: int, kv_seq: int, *, causal: bool,
+                local_window: Optional[int], dtype) -> Tuple[int, int, int]:
+    """(block_q, block_kv, block_kv_compute) for this shape.
+
+    Hand-tuned default: largest legal edge, capped at ``_DIAG_FINE_BLOCK``
+    for causal/windowed masks at long sequence (the layout-aware diagonal
+    tiling — see the module docstring), with kv-compute sub-blocks at half
+    the kv block (fused-backward sweet spot of the measured v5e grid).  A
+    persisted autotune winner overrides when it divides the shape.
+    """
+    bq, bkv = _pick_block(q_seq), _pick_block(kv_seq)
+    if (causal or local_window is not None) and max(
+            q_seq, kv_seq) >= _DIAG_FINE_MIN_SEQ:
+        bq = min(bq, _pick_block(min(_DIAG_FINE_BLOCK, q_seq)))
+        bkv = min(bkv, _pick_block(min(_DIAG_FINE_BLOCK, kv_seq)))
+    default = (bq, bkv, max(bkv // 2, _BLOCK))
+    fields = autotune.attention_sweep_key_fields(
+        {"q_seq": q_seq, "kv_seq": kv_seq, "dtype": str(dtype)},
+        causal=bool(causal), window=int(local_window or 0))
+
+    def _legal(c) -> bool:
+        return (len(c) == 3 and q_seq % c[0] == 0 and kv_seq % c[1] == 0
+                and c[1] % c[2] == 0 and c[2] >= _BLOCK)
+
+    return autotune.lookup("splash", fields, default, validate=_legal)
 
 
 @functools.lru_cache(maxsize=64)
 def _build_kernel(q_seq: int, kv_seq: int, q_heads_per_kv: int,
                   causal: bool, soft_cap: Optional[float],
                   interpret: bool = False,
-                  local_window: Optional[int] = None):
+                  local_window: Optional[int] = None,
+                  blocks: Optional[Tuple[int, int, int]] = None):
     """Mask processing runs host-side on numpy and is the expensive part —
-    cache the built kernel per (shape, group, mask) signature.
+    cache the built kernel per (shape, group, mask, blocks) signature.
 
     ``ensure_compile_time_eval`` keeps the kernel's mask-info arrays real
     device constants even when this is first called inside a jit trace;
@@ -81,12 +129,14 @@ def _build_kernel(q_seq: int, kv_seq: int, q_heads_per_kv: int,
         head_mask = (sm.CausalMask((q_seq, kv_seq)) if causal
                      else sm.FullMask((q_seq, kv_seq)))
     mask = sm.MultiHeadMask([head_mask for _ in range(q_heads_per_kv)])
-    bq, bkv = _pick_block(q_seq), _pick_block(kv_seq)
+    if blocks is None:
+        blocks = _block_plan(q_seq, kv_seq, causal=causal,
+                             local_window=local_window, dtype=jnp.bfloat16)
+    bq, bkv, bkvc = blocks
     # Fused dq+dkv backward (one bwd pass instead of two) with kv-compute
     # sub-blocks at half the kv block: best of the measured grid on the
     # Llama-1B/v5e bench (~+6% step time vs plain 512 blocks + split bwd);
     # block_*_dq are unused in fused mode.
-    bkvc = max(bkv // 2, _BLOCK)
     sizes = sk.BlockSizes(
         block_q=bq, block_kv=bkv, block_kv_compute=bkvc,
         block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkvc,
@@ -155,12 +205,15 @@ def splash_attention_bshd(
             segment_ids = jnp.pad(segment_ids, ((0, 0), (0, pad_q)))
         S, Skv = S + pad_q, Skv + pad_kv
 
+    window = (None if local_window_size is None else int(local_window_size))
+    blocks = _block_plan(S, Skv, causal=causal, local_window=window,
+                         dtype=q.dtype)
     kernel = _build_kernel(S, Skv, G, causal,
                            None if logits_soft_cap is None
                            else float(logits_soft_cap),
                            interpret=_INTERPRET,
-                           local_window=(None if local_window_size is None
-                                         else int(local_window_size)))
+                           local_window=window,
+                           blocks=blocks)
 
     # The kernel has no sm_scale param: fold the scale into q.
     qs = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)
@@ -221,3 +274,82 @@ def sharded_splash_attention(
         inner, mesh=mesh,
         in_specs=(qspec, qspec, qspec, sspec), out_specs=qspec,
         check_vma=False)(q, k, v, segment_ids.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Registry rung + autotune adapter
+# ---------------------------------------------------------------------------
+def _attention_probe(request) -> bool:
+    if request.get("traced_window"):
+        # a TRACED window (per-layer scalar riding a scan) cannot steer the
+        # host-side mask build; only SDPA expresses it
+        return False
+    return splash_attention_available(
+        request["q_seq"], request["kv_seq"], request["head_dim"])
+
+
+def _attention_impl(request, q, k, v, *, causal=True, segment_ids=None,
+                    attention_mask=None, scale=None, logits_soft_cap=None,
+                    local_window_size=None):
+    mesh = request.get("mesh")
+    if mesh is not None:
+        # pallas_call must run per-shard under GSPMD
+        return sharded_splash_attention(
+            q, k, v, mesh, causal=causal, segment_ids=segment_ids,
+            attention_mask=attention_mask, scale=scale,
+            logits_soft_cap=logits_soft_cap,
+            local_window_size=local_window_size)
+    return splash_attention_bshd(
+        q, k, v, causal=causal, segment_ids=segment_ids,
+        attention_mask=attention_mask, scale=scale,
+        logits_soft_cap=logits_soft_cap,
+        local_window_size=local_window_size)
+
+
+def _sweep_key_fields(req):
+    return autotune.attention_sweep_key_fields(
+        req, causal=bool(req.get("causal", True)),
+        window=int(req.get("local_window_size") or 0))
+
+
+def _sweep_candidates(req):
+    out = []
+    for b in (1024, 512, 256):
+        if req["q_seq"] % b or req["kv_seq"] % b:
+            continue
+        for bkvc in (b, b // 2):
+            if bkvc >= _BLOCK:
+                out.append((b, b, bkvc))
+    return out or [(_BLOCK, _BLOCK, _BLOCK)]
+
+
+def _sweep_run(req, choice) -> float:
+    B = int(req.get("batch", 1))
+    S, Skv = req["q_seq"], req["kv_seq"]
+    Hq = int(req.get("num_q_heads", 8))
+    Hk = int(req.get("num_kv_heads", Hq))
+    D = req["head_dim"]
+    dtype = jnp.dtype(req.get("dtype", "bfloat16"))
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (B, S, Hq, D), jnp.float32).astype(dtype)
+    k = jax.random.normal(key, (B, Skv, Hk, D), jnp.float32).astype(dtype)
+    v = jax.random.normal(key, (B, Skv, Hk, D), jnp.float32).astype(dtype)
+
+    def loss(q, k, v):
+        return jnp.sum(splash_attention_bshd(
+            q, k, v, causal=bool(req.get("causal", True)),
+            local_window_size=req.get("local_window_size"),
+        ).astype(jnp.float32))
+
+    fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    return autotune.time_call(fn, q, k, v)
+
+
+from automodel_tpu.ops.kernel_lib.parity import sdpa_reference  # noqa: E402
+
+registry.register_kernel(
+    "attention.splash", probe=_attention_probe, impl=_attention_impl,
+    fallback="attention.flash", reference=sdpa_reference)
+autotune.register_sweep(
+    "splash", key_fields=_sweep_key_fields, candidates=_sweep_candidates,
+    run=_sweep_run)

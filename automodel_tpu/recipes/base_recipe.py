@@ -110,6 +110,39 @@ class BaseRecipe:
 
         apply_compile_config(build_compile_config(cfg.get("compile")))
 
+    def _setup_kernel_autotune(self, cfg: Optional[ConfigNode], *,
+                               model=None, seq_len=None,
+                               local_batch: int = 1, cp: int = 1) -> None:
+        """Wire the Pallas block-size autotuner from the ``kernels:`` YAML
+        section (``ops/kernel_lib/autotune.py``; call AFTER
+        :meth:`_setup_compile_cache` so the cache lands alongside the XLA
+        compile cache by default)::
+
+            kernels:
+              autotune: on          # off (default) | on | force
+              autotune_cache: /path/pallas_autotune_v1.json   # optional
+
+        With ``on``/``force`` and a model, the block-shape sweep for this
+        run's (kernel, shape) keys executes HERE — before the first train
+        step traces — so a cold run pays the sweep once at setup and a
+        warm cache makes it free.  A corrupt cache degrades to hand-tuned
+        defaults (never fails setup); multihost runs never sweep (winners
+        must be identical on every host — pre-warm via tools/autotune.py).
+        """
+        from automodel_tpu.ops.kernel_lib import autotune
+
+        kcfg = cfg.get("kernels") if cfg is not None else None
+        mode = kcfg.get("autotune") if kcfg is not None else None
+        cache_path = kcfg.get("autotune_cache") if kcfg is not None else None
+        tuner = autotune.configure_autotune(mode, cache_path)
+        if tuner.mode == "off" or model is None:
+            return
+        requests = autotune.training_sweep_requests(
+            model, seq_len=seq_len, local_batch=local_batch, cp=cp)
+        if requests:
+            report = tuner.sweep_requests(requests)
+            logger.info("kernel autotune sweep: %s", report)
+
     # -- timers (optional: _TinyRecipe-style harnesses have none) ------------
     def _record_timer(self, name: str):
         timers = getattr(self, "timers", None)

@@ -396,6 +396,23 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         self.lr_scheduler = build_lr_scheduler(
             cfg.get("lr_scheduler"), cfg.get("optimizer"), total)
 
+        # Kernel block-size autotune (after the compile cache so the
+        # winner cache lands beside it; before the first train-step trace
+        # so a cold sweep's choices are what the step compiles with)
+        self._setup_kernel_autotune(
+            cfg, model=self.model,
+            # packed rows pin S exactly; the VLM subclass pins it via
+            # dataloader.fixed_length; unpacked-variable runs sweep nothing
+            # (their bucketed shapes still hit any warm cache entries)
+            seq_len=(int(cfg.get("packed_sequence.packed_sequence_size", 0)
+                         or 0)
+                     or int(cfg.get("dataloader.fixed_length", 0) or 0)
+                     or None),
+            local_batch=local_bs,
+            # cp>1 dispatch resolves to the ring, so the plan sweeps the
+            # ring's inner-tile key instead of splash
+            cp=getattr(self.mesh_manager, "cp_size", 1))
+
         self.checkpoint_config = build_checkpoint_config(cfg.get("checkpoint"))
         if self.peft_config is not None:
             self.checkpoint_config.is_peft = True

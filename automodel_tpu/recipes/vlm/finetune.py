@@ -12,6 +12,13 @@ freeze policy masks embeddings/vision tower via the optax trainable-mask
 instead of ``requires_grad`` surgery.  The jitted train step is shared; VLM
 batches simply carry ``pixel_values`` which the step shards over dp.
 
+Kernel block-size autotuning (``kernels.autotune``, docs/guides/
+kernels.md) is likewise inherited through the shared ``setup()``: the
+setup-time sweep derives its attention/CE shapes from
+``dataloader.fixed_length`` here (VLM batches are fixed-length padded
+rather than packed), so pinning that knob — already required for
+multi-host input sharding — is also what makes this recipe sweepable.
+
 Checkpointing (the full ``checkpoint:`` YAML surface — atomic commit,
 ``restore_from``, ``keep_last_k``/``keep_every_n_steps`` retention,
 ``io_retries``, and the asynchronous snapshot-to-host save path behind

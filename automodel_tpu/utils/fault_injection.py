@@ -53,6 +53,16 @@ Input-pipeline points (see ``datasets/prefetch.py``):
                       is produced — fires as a raised exception in the
                       TRAINING loop within one step (forwarded through the
                       queue; the consumer never hangs on a dead producer)
+
+Kernel-substrate points (see ``ops/kernel_lib/autotune.py``):
+
+    kernel_autotune_cache
+                      at the top of the block-size autotune cache READ —
+                      a corrupt/unreadable cache file.  The contract under
+                      drill: warn once, degrade to the hand-tuned block
+                      defaults, NEVER fail recipe setup (the fault is
+                      swallowed by the load path's degradation handler,
+                      not surfaced).
 """
 
 from __future__ import annotations
@@ -81,6 +91,7 @@ KNOWN_FAULT_POINTS = frozenset({
     "ckpt_pre_rename",
     "ckpt_post_commit",
     "input_producer",
+    "kernel_autotune_cache",
 })
 
 

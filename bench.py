@@ -39,6 +39,12 @@ synchronous loader path (``BENCH_PREFETCH=k`` sets depth k), so the async
 input pipeline's with/without delta is measurable in one line:
 ``BENCH_MATRIX=0 python bench.py`` vs
 ``BENCH_MATRIX=0 BENCH_PREFETCH=0 python bench.py``.
+
+Kernel-substrate telemetry rides the secondaries: ``autotune_cache_hit``
+(the block-size winner table was served warm — no sweep, every lookup
+cached) and ``autotune_blocks`` (the chosen shapes).  ``BENCH_AUTOTUNE=
+{on,off,force}`` pins ``kernels.autotune``; default off, so a timed run
+never pays a sweep — with ``on`` the sweep runs at setup, before warmup.
 """
 
 from __future__ import annotations
@@ -165,12 +171,27 @@ def _prefetch_overrides() -> list:
     return ["--dataloader.prefetch_depth", str(int(depth))]
 
 
+def _autotune_overrides() -> list:
+    """``BENCH_AUTOTUNE={on,off,force}`` pins the kernel block-size
+    autotuner (``kernels.autotune``).  Unset keeps the recipe default
+    (off — hand-tuned blocks), so a timed run never pays a sweep it did
+    not ask for; with ``on`` any sweep runs at SETUP, before the warmup,
+    and the result JSON reports ``autotune_cache_hit`` + the chosen block
+    shapes."""
+    mode = os.environ.get("BENCH_AUTOTUNE", "")
+    if mode == "":
+        return []
+    mode = {"1": "on", "0": "off"}.get(mode, mode)
+    return ["--kernels.autotune", mode]
+
+
 def _run_recipe(recipe_cls, yaml, overrides, steps, warmup):
     from automodel_tpu.config.arg_parser import parse_args_and_load_config
     from automodel_tpu.training.timers import INPUT_TIMERS, input_idle_fraction
 
     cfg = parse_args_and_load_config(
-        ["--config", yaml] + _prefetch_overrides() + overrides)
+        ["--config", yaml] + _prefetch_overrides() + _autotune_overrides()
+        + overrides)
     recipe = recipe_cls(cfg).setup()
 
     def stream():
@@ -572,6 +593,17 @@ def main() -> None:
     }
     if secondary is not None:
         result["secondary"] = secondary
+    # Kernel-substrate telemetry: was the block-size winner table served
+    # warm (no sweep, every lookup cached), and which blocks ran.  Reported
+    # with the secondaries; mode off reports cache_hit=false and no blocks
+    # (hand-tuned defaults — not cache-served — were used).
+    from automodel_tpu.ops.kernel_lib.autotune import autotune_report
+
+    tune = autotune_report()
+    bucket = secondary if secondary is not None else result
+    bucket["autotune_cache_hit"] = bool(tune["cache_hit"])
+    if tune["chosen"]:
+        bucket["autotune_blocks"] = tune["chosen"]
     print(json.dumps(result))
 
 

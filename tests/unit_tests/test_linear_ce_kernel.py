@@ -16,11 +16,15 @@ import pytest
 import automodel_tpu.ops.linear_ce_kernel as lck
 from automodel_tpu.loss.linear_ce import FusedLinearCrossEntropy
 from automodel_tpu.loss.masked_ce import IGNORE_INDEX
+from automodel_tpu.ops.kernel_lib import parity
 
 
 @pytest.fixture(autouse=True)
-def _interpret(monkeypatch):
-    monkeypatch.setattr(lck, "_INTERPRET", True)
+def _interpret():
+    # the shared harness's interpret context (test_kernel_substrate.py runs
+    # the common parity matrix; this module keeps kernel-specific edges)
+    with parity.interpret_mode():
+        yield
 
 
 def _ref_lse_pick(h, w, labels):

@@ -210,6 +210,53 @@ def test_l005_registry_matches_docstring_points():
 
 
 # ---------------------------------------------------------------------------
+# L006 — Pallas block/grid/compiler-params construction off the substrate
+# ---------------------------------------------------------------------------
+def test_l006_flags_raw_blockspec_and_gridspec_construction():
+    hits = _lint(
+        "from jax.experimental import pallas as pl\n"
+        "spec = pl.BlockSpec((128, 128), lambda i, j: (i, j))\n")
+    assert _rules(hits) == ["L006"]
+    assert "kernel_lib" in hits[0].message
+    hits = _lint(
+        "from jax.experimental.pallas import tpu as pltpu\n"
+        "g = pltpu.PrefetchScalarGridSpec(num_scalar_prefetch=1, grid=(1,))\n")
+    assert _rules(hits) == ["L006"]
+    # importing the class out of pallas is flagged at the import
+    hits = _lint("from jax.experimental.pallas import BlockSpec\n")
+    assert _rules(hits) == ["L006"]
+    # the natural long-form alias is covered too
+    hits = _lint(
+        "import jax.experimental.pallas as pallas\n"
+        "spec = pallas.BlockSpec((128, 128), lambda i: (i,))\n")
+    assert _rules(hits) == ["L006"]
+
+
+def test_l006_flags_raw_compiler_params_shim_calls():
+    hits = _lint(
+        "from automodel_tpu.utils.jax_compat import "
+        "pallas_tpu_compiler_params\n"
+        "p = pallas_tpu_compiler_params(dimension_semantics=())\n")
+    assert _rules(hits) == ["L006"]
+    assert "tiling.compiler_params" in hits[0].message
+
+
+def test_l006_exempts_the_substrate_and_accepts_suppressions():
+    src = ("from jax.experimental import pallas as pl\n"
+           "spec = pl.BlockSpec((8, 8), lambda i: (i,))\n")
+    assert _lint(src, rel="automodel_tpu/ops/kernel_lib/tiling.py") == []
+    suppressed = ("from jax.experimental import pallas as pl\n"
+                  "spec = pl.BlockSpec((8, 8), lambda i: (i,))"
+                  "  # lint: disable=L006 (one-off debug kernel)\n")
+    assert _lint(suppressed) == []
+    # routing through the substrate is the sanctioned spelling
+    assert _lint(
+        "from automodel_tpu.ops.kernel_lib import tiling\n"
+        "spec = tiling.vmem_block_spec((8, 8), lambda i: (i,))\n"
+        "cp = tiling.compiler_params()\n") == []
+
+
+# ---------------------------------------------------------------------------
 # Rule selection + output formats
 # ---------------------------------------------------------------------------
 def test_select_restricts_rules():

@@ -1,5 +1,13 @@
 """Splash attention vs SDPA parity — runs the real kernel logic in Pallas
 interpret mode on the CPU suite; on-hardware checks live in ``tpu_tests/``.
+
+The common shape/segment/GQA matrix now lives in the SHARED parity harness
+(``ops/kernel_lib/parity.py``, driven by ``test_kernel_substrate.py``);
+this module keeps the splash-SPECIFIC edges: the pad-to-256 alignment
+path, LocalMask window-boundary discrimination, and gradient parity.
+
+D=128: this JAX's upstream MQA kernel requires ``head_dim % 128 == 0`` at
+trace time.
 """
 
 import jax
@@ -9,13 +17,15 @@ import pytest
 
 from automodel_tpu.ops import splash_attention as sa
 from automodel_tpu.ops.attention import dot_product_attention
+from automodel_tpu.ops.kernel_lib import parity
 
-B, S, Hq, Hk, D = 1, 256, 4, 2, 32
+B, S, Hq, Hk, D = 1, 256, 4, 2, 128
 
 
 @pytest.fixture(autouse=True)
-def _interpret_mode(monkeypatch):
-    monkeypatch.setattr(sa, "_INTERPRET", True)
+def _interpret_mode():
+    with parity.interpret_mode():
+        yield
 
 
 def _qkv(seed=0):
