@@ -1,0 +1,307 @@
+"""The elastic-recovery drill: one deterministic slice-loss rehearsal.
+
+One home for the tiny multi-slice training run that ``__graft_entry__.
+dryrun_multichip`` exercises as its elastic leg, the tier-1 fault drills
+(``tests/unit_tests/test_elastic.py``), and the ``elastic`` bench secondary
+— so the acceptance surface ("a run that loses a slice shrinks, rescales
+deterministically, and keeps training") cannot drift between them.
+
+The drill trains the flagship tiny Llama on a ``dcn_dp=2`` mesh (2 emulated
+slices over the 8-device CPU mesh), checkpoints asynchronously, loses a
+slice mid-run via the deterministic ``slice_loss`` fault point, recovers
+through the REAL recipe machinery (``BaseRecipe.recover_from_slice_loss``:
+shrink -> rescale -> restore-from-last-committed), and finishes on the
+shrunk mesh.  Its acceptance check is parity: every post-recovery step's
+loss/grad_norm must match an UNINTERRUPTED run on the shrunk mesh resumed
+from the same checkpoint to < 1e-3, and ``assert_compiles_once`` must hold
+after the rebuild.
+
+Batch geometry is the rescale rule made concrete: every optimizer step
+consumes the same ``ROWS_PER_STEP`` deterministic rows (seeded by step
+number), reshaped ``[grad_acc, local*dp, S]`` for whatever mesh is current
+— losing a slice halves ``dp`` and doubles ``grad_acc``, so tokens/step,
+the LR schedule, and the per-token LR are all unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+S = 32              # tokens per row
+LOCAL_BS = 1        # rows per device-shard per microbatch (pinned by rescale)
+BASE_GRAD_ACC = 2   # grad-accumulation steps at full dcn_dp
+
+
+class _Stateful:
+    """Minimal tracked host-state (exercises the pickle path of saves)."""
+
+    def __init__(self):
+        self.value = 0
+
+    def state_dict(self):
+        return {"value": self.value}
+
+    def load_state_dict(self, sd):
+        self.value = sd["value"]
+
+
+def drill_batch(step: int, grad_acc: int, dp_size: int):
+    """The step's microbatch stack [A, B, S] — the SAME global rows for a
+    given step on every mesh geometry (rows = grad_acc * local * dp is
+    invariant under the rescale rule), so an uninterrupted shrunk-mesh run
+    and a recovered run consume identical data."""
+    from automodel_tpu.loss.masked_ce import IGNORE_INDEX
+
+    rows = grad_acc * LOCAL_BS * dp_size
+    rng = np.random.default_rng(10_000 + step)
+    ids = rng.integers(0, 255, (rows, S))
+    labels = np.roll(ids, -1, -1)
+    labels[..., -1] = IGNORE_INDEX
+    shape = (grad_acc, LOCAL_BS * dp_size, S)
+    return {"input_ids": ids.reshape(shape).astype(np.int32),
+            "labels": labels.reshape(shape).astype(np.int32)}
+
+
+def _build_recipe(ckpt_dir: str, *, dcn_dp: int = 2,
+                  devices=None, async_save: bool = True):
+    import jax
+
+    from automodel_tpu.analysis.legs import flagship_tiny_model
+    from automodel_tpu.checkpoint.checkpointing import CheckpointingConfig
+    from automodel_tpu.distributed.mesh import MeshManager
+    from automodel_tpu.distributed.shardings import build_parallel_plan
+    from automodel_tpu.loss.linear_ce import FusedLinearCrossEntropy
+    from automodel_tpu.optim import build_optimizer
+    from automodel_tpu.recipes.base_recipe import BaseRecipe
+    from automodel_tpu.training.step_scheduler import StepScheduler
+    from automodel_tpu.training.timers import Timers
+    from automodel_tpu.training.train_step import build_train_step
+
+    devices = list(devices if devices is not None else jax.devices())
+    rec = BaseRecipe()
+    rec.checkpoint_config = CheckpointingConfig(
+        checkpoint_dir=str(ckpt_dir), model_save_format="orbax",
+        save_consolidated=False, async_save=async_save)
+    rec.timers = Timers()
+    rec.mesh_manager = MeshManager(
+        dcn_dp_size=dcn_dp, tp_size=2, cp_size=1, devices=devices)
+    rec.model = flagship_tiny_model()
+    rec.optimizer = build_optimizer(name="adamw", lr=1e-3, weight_decay=0.01)
+    rec.loss_fn = FusedLinearCrossEntropy(chunk_len=16)
+
+    def builder(mm):
+        plan = build_parallel_plan(rec.model, mm)
+        fns = build_train_step(rec.model, rec.optimizer,
+                               loss_fn=rec.loss_fn, plan=plan)
+        return plan, fns
+
+    rec._parallelism_builder = builder
+    rec.plan, rec.step_fns = builder(rec.mesh_manager)
+    rec.param_sharding = rec.plan.param_sharding
+    rec.params = rec.plan.shard_params(rec.model.init(jax.random.key(0)))
+    rec.opt_state = rec.step_fns.init_opt_state(rec.params)
+    rec.step_scheduler = StepScheduler(grad_acc_steps=BASE_GRAD_ACC)
+    from automodel_tpu.utils.elastic import ElasticState
+
+    rec.elastic_state = ElasticState(dcn_dp, BASE_GRAD_ACC)
+    rec.drill_state = _Stateful()
+    return rec
+
+
+def train_one_step(rec, step: int) -> Tuple[float, float]:
+    """Dispatch one deterministic optimizer step; (loss, grad_norm)."""
+    sched = rec.step_scheduler
+    batch = rec.step_fns.shard_batch(drill_batch(
+        step, sched.grad_acc_steps, rec.mesh_manager.dp_size))
+    rec.params, rec.opt_state, out = rec.step_fns.train_step(
+        rec.params, rec.opt_state, batch)
+    sched.step = step
+    rec.drill_state.value = step
+    vals = np.asarray(out["_packed"], np.float32)  # one d2h, off hot loop
+    return float(vals[0]), float(vals[1])
+
+
+def run_elastic_drill(root: str, *, total_steps: int = 6, save_step: int = 2,
+                      fault_step: int = 4, devices=None,
+                      compare_reference: bool = True) -> Dict:
+    """The raise-mode drill end to end.  Returns a report dict with
+    per-step metrics, recovery info, goodput accounting, and (when
+    ``compare_reference``) the max |recovered - uninterrupted| deviation.
+
+    The caller owns fault arming: ``fault_injection.configure_faults(
+    f"slice_loss:{fault_step}")`` (the coordinator is polled once per step,
+    so the N-th poll IS step N)."""
+    from automodel_tpu.analysis.jaxpr_audit import assert_compiles_once
+    from automodel_tpu.checkpoint.checkpointing import is_committed
+    from automodel_tpu.training.timers import (
+        ELASTIC_TIMERS,
+        goodput_fraction,
+        recovery_time_s,
+    )
+    from automodel_tpu.utils.elastic import ElasticCoordinator, SliceLostError
+
+    t_run0 = time.perf_counter()
+    ckpt_dir = os.path.join(root, "elastic_ckpt")
+    rec = _build_recipe(ckpt_dir, dcn_dp=2, devices=devices)
+    coord = ElasticCoordinator(rec.mesh_manager, heartbeat_timeout_s=5.0)
+    metrics: Dict[int, Tuple[float, float]] = {}
+    recovery: Optional[Dict] = None
+    committed: Optional[str] = None
+
+    step = 0
+    while step < total_steps:
+        step += 1
+        try:
+            metrics[step] = train_one_step(rec, step)
+            if step == save_step:
+                committed = rec.save_checkpoint(0, step)
+            coord.poll(step)
+        except SliceLostError as e:
+            rec.timers("elastic_detect").add(coord.detect_latency_s())
+            recovery = rec.recover_from_slice_loss(e)
+            coord.mesh_manager = rec.mesh_manager
+            restored_step = rec.step_scheduler.step
+            assert restored_step == save_step, (
+                f"recovery resumed at step {restored_step}, expected the "
+                f"last committed step {save_step}")
+            # replay: the steps between the restored checkpoint and the
+            # failure are re-trained — pure goodput loss, timed as such
+            with rec.timers.record("elastic_replay"):
+                for s in range(restored_step + 1, step + 1):
+                    metrics[s] = train_one_step(rec, s)
+            # continue the loop from the failure step (already replayed)
+    rec.teardown()
+    assert committed is not None and is_committed(committed)
+    assert recovery is not None, (
+        f"slice_loss fault never fired (armed for step {fault_step}?)")
+    # post-rebuild recompile guard: every post-recovery step after the
+    # first must be a cache hit on the SHRUNK mesh's step function
+    assert_compiles_once(rec.step_fns.train_step, "elastic rebuilt step")
+
+    window = time.perf_counter() - t_run0
+    elapsed = rec.timers.get_elapsed(names=list(ELASTIC_TIMERS), reset=False)
+    report = {
+        "metrics": metrics,
+        "recovery": recovery,
+        "committed": committed,
+        "recovery_time_s": recovery_time_s(elapsed),
+        "goodput_fraction": goodput_fraction(elapsed, window),
+        "window_s": window,
+        "max_dev_vs_uninterrupted": None,
+    }
+
+    if compare_reference:
+        # The oracle: an UNINTERRUPTED run on the shrunk mesh, resumed from
+        # the same committed checkpoint with the same rescaled geometry —
+        # identical data, identical program, so the recovered run must
+        # match it to float-noise (< 1e-3).
+        ref = _build_recipe(ckpt_dir, dcn_dp=1,
+                            devices=rec.mesh_manager.slice_devices(0))
+        ref.step_scheduler.grad_acc_steps = (
+            BASE_GRAD_ACC * recovery["accum_factor"])
+        restored = ref.load_checkpoint()
+        assert restored == committed
+        worst = 0.0
+        for s in range(save_step + 1, total_steps + 1):
+            loss, gn = train_one_step(ref, s)
+            worst = max(worst, abs(loss - metrics[s][0]),
+                        abs(gn - metrics[s][1]))
+        ref.teardown()
+        report["max_dev_vs_uninterrupted"] = worst
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Kill-mode phases (subprocess drills: the hosts of the dying slice)
+# ---------------------------------------------------------------------------
+class _SlowSecondPickle:
+    """Host-state whose SECOND pickling blocks — so the first save commits
+    fast and the next save's background commit is deterministically still
+    in flight when a ``:kill`` fault lands (the kill-mid-async-commit
+    drill).  Deep-copies pass through (the snapshot boundary must stay
+    instant); only the committer thread's pickle blocks."""
+
+    calls = 0
+
+    def __deepcopy__(self, memo):
+        return self
+
+    def __reduce__(self):
+        type(self).calls += 1
+        if type(self).calls > 1:
+            time.sleep(120)  # far beyond the drill's lifetime: killed first
+        return (str, ("drill",))
+
+
+class _GatedState:
+    def state_dict(self):
+        return {"payload": _SlowSecondPickle()}
+
+    def load_state_dict(self, sd):
+        pass
+
+
+def drill_phase1_kill(root: str, *, saves=(2, 4), total_steps: int = 8,
+                      slow_second_commit: bool = False) -> None:
+    """Phase 1 of the kill drill: train on the dcn_dp=2 mesh, saving at
+    ``saves``; the caller arms ``AUTOMODEL_FAULT=elastic_heartbeat:N:kill``
+    (or ``slice_loss:N:kill``) in this process's env, so the process
+    hard-exits (113) at poll N — between heartbeats, exactly like a
+    preempted host.  With ``slow_second_commit`` the save dispatched at
+    ``saves[1]`` is still mid-background-commit when the kill lands, so
+    phase 2 must fall back to the PREVIOUS committed step."""
+    from automodel_tpu.utils.elastic import ElasticCoordinator
+
+    rec = _build_recipe(os.path.join(root, "elastic_ckpt"), dcn_dp=2)
+    if slow_second_commit:
+        rec.gate_state = _GatedState()
+    coord = ElasticCoordinator(rec.mesh_manager, heartbeat_timeout_s=5.0)
+    for step in range(1, total_steps + 1):
+        train_one_step(rec, step)
+        if step in saves:
+            rec.save_checkpoint(0, step)
+            if not (slow_second_commit and step == max(saves)):
+                # land the commit deterministically so the drilled kill is
+                # unambiguously after the background protocol finished
+                rec.join_pending_save()
+            else:
+                # ...or, for the gated save, unambiguously DURING it: wait
+                # until the committer thread is inside the gated pickle
+                # (staging created, model written, manifest not yet) so the
+                # kill at the next poll is a true mid-async-commit death
+                deadline = time.monotonic() + 30
+                while (_SlowSecondPickle.calls < 2
+                       and time.monotonic() < deadline):
+                    time.sleep(0.01)
+        coord.poll(step)  # the armed kill fires here
+    rec.teardown()
+
+
+def drill_phase2_resume(root: str, *, expect_step: int,
+                        extra_steps: int = 2) -> Dict:
+    """Phase 2: the relaunch at shrunk topology (dcn_dp=1 over the
+    surviving slice's devices).  Resumes WITHOUT operator action from the
+    last COMMITTED checkpoint — asserts it is ``expect_step`` — applies the
+    rescale rule, and trains ``extra_steps`` more to prove the run is live."""
+    from automodel_tpu.utils.elastic import rescale_for_slice_loss
+
+    full = _build_recipe(os.path.join(root, "elastic_ckpt"), dcn_dp=2)
+    survivors = full.mesh_manager.slice_devices(0)
+    rec = _build_recipe(os.path.join(root, "elastic_ckpt"), dcn_dp=1,
+                        devices=survivors)
+    rescale = rescale_for_slice_loss(2, 1)
+    rec.step_scheduler.grad_acc_steps = BASE_GRAD_ACC * rescale.accum_factor
+    restored = rec.load_checkpoint()
+    assert restored is not None, "no committed checkpoint to resume from"
+    got = rec.step_scheduler.step
+    assert got == expect_step, (
+        f"resumed at step {got}, expected last committed step {expect_step}")
+    out = {}
+    for s in range(got + 1, got + 1 + extra_steps):
+        out[s] = train_one_step(rec, s)
+    rec.teardown()
+    return {"restored": restored, "restored_step": got, "metrics": out}

@@ -21,11 +21,15 @@ from typing import Any, Tuple
 from automodel_tpu.analysis.jaxpr_audit import CollectiveCensus, census_of
 
 # Census legs: the dp2 x cp2 x tp2 flagship under both cp sequence layouts,
-# and the MoE expert-parallel leg (sorted dispatch — the default).
+# the MoE expert-parallel leg (sorted dispatch — the default), and the
+# hierarchical-DP multi-slice leg (2 emulated slices over dcn_dp — the
+# structural pin that cross-slice gradient traffic stays on dcn_dp only
+# while dense FSDP/TP collectives stay on the inner ICI axes).
 LEG_NAMES: Tuple[str, ...] = (
     "dp2xcp2xtp2_contiguous",
     "dp2xcp2xtp2_zigzag",
     "moe_ep",
+    "dcn2_dp2xtp2",
 )
 
 # Audit threshold for the tiny legs: every weight matrix of the tiny
@@ -103,7 +107,19 @@ def build_leg(name: str, dp: int = 2, cp: int = 2, tp: int = 2) -> Leg:
     if name not in LEG_NAMES:
         raise ValueError(f"unknown census leg {name!r}; known: {LEG_NAMES}")
 
-    if name == "moe_ep":
+    if name == "dcn2_dp2xtp2":
+        # Hierarchical DP over 2 emulated slices: dcn_dp=2 x dp_shard=2 x
+        # tp=2 (the elastic dryrun topology).  Params replicate across
+        # dcn_dp; the census must show the per-step grad all-reduce as the
+        # ONLY dcn_dp collective, with FSDP gathers/scatters on dp_shard.
+        mm = MeshManager(dcn_dp_size=2, dp_size=2 * dp, tp_size=tp,
+                         cp_size=1, sequence_parallel=True)
+        model = flagship_tiny_model()
+        plan = build_parallel_plan(model, mm)
+        fns = build_train_step(
+            model, build_optimizer(name="adamw", lr=1e-3, weight_decay=0.01),
+            loss_fn=FusedLinearCrossEntropy(chunk_len=16), plan=plan)
+    elif name == "moe_ep":
         # MoE/EP leg keeps the contiguous layout, exactly like the dryrun
         # (its batches are placed without the zig-zag host permutation).
         mm = MeshManager(dp_size=dp, tp_size=tp, cp_size=cp,
@@ -127,8 +143,10 @@ def build_leg(name: str, dp: int = 2, cp: int = 2, tp: int = 2) -> Leg:
                            plan.param_sharding)
     abs_opt = _abstract(jax.eval_shape(fns.init_opt_state, abs_params),
                         fns.opt_state_sharding)
-    # [A=2 grad-acc, B, S]: the dryrun's batch geometry.
-    B, S = 2 * dp, 16 * cp * tp
+    # [A=2 grad-acc, B, S]: the dryrun's batch geometry, derived from the
+    # ACTUAL mesh (the dcn leg runs cp=1 and a dcn_dp x dp_shard batch dim).
+    B = max(mm.dp_size, 2 * dp)
+    S = 16 * mm.cp_size * mm.tp_size
     tok = jax.ShapeDtypeStruct((2, B, S), jnp.int32,
                                sharding=fns.microbatch_sharding)
     batch = {"input_ids": tok, "labels": tok}

@@ -3,24 +3,37 @@
 Where the reference builds a 4-D ``torch.distributed`` DeviceMesh and flattens
 submeshes (``nemo_automodel/components/distributed/fsdp2.py:117-221``), the TPU
 design is a single ``jax.sharding.Mesh`` with axes
-``('pp', 'dp_replicate', 'dp_shard', 'cp', 'tp')`` (``pp`` is the reserved
-size-1 pipeline seam — see the design note below).  "Flattened" submeshes are not
-separate objects in JAX — a PartitionSpec may name a *tuple* of axes, so the
-reference's ``dp``/``dp_shard_cp``/``dp_cp`` flattened views become the axis
-tuples returned by :data:`DP_AXES`, :data:`FSDP_AXES`, :data:`LOSS_AXES`.
+``('dcn_dp', 'pp', 'dp_replicate', 'dp_shard', 'cp', 'tp')`` (``pp`` is the
+reserved size-1 pipeline seam — see the design note below).  "Flattened"
+submeshes are not separate objects in JAX — a PartitionSpec may name a *tuple*
+of axes, so the reference's ``dp``/``dp_shard_cp``/``dp_cp`` flattened views
+become the axis tuples returned by :data:`DP_AXES`, :data:`FSDP_AXES`,
+:data:`LOSS_AXES`.
 
-HSDP guidance (scaling-book): the replicate axis is outermost so it lands on
-DCN between slices; shard/cp/tp axes ride ICI.
+Multi-slice (``dcn_dp``): the OUTERMOST axis is hierarchical data
+parallelism across TPU slices.  Parameters are replicated across it (no
+param spec ever names it), so the only cross-slice traffic is the per-step
+gradient all-reduce — one small collective over DCN — while the dense FSDP
+all-gathers / reduce-scatters and TP/CP collectives stay on the inner ICI
+axes.  On a real pool each ``dcn_dp`` block is one slice (devices grouped
+by ``slice_index``); on CPU/dryrun the device list is partitioned into
+``dcn_dp`` contiguous EMULATED slices so elastic drills run on the virtual
+8-device mesh.  HSDP guidance (scaling-book): replicate-like axes are
+outermost so they land on DCN between slices; shard/cp/tp axes ride ICI.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence, Tuple
+import logging
+import os
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
 
 # Canonical axis names, outermost (DCN) to innermost (ICI).
 #
@@ -42,22 +55,26 @@ from jax.sharding import Mesh
 #   tolerates DCN latency; dense collectives stay on the inner ICI axes.
 # * Checkpoints are unaffected: Orbax stores global arrays, and the
 #   mesh-reshape restore tests prove resharding across layouts.
+AXIS_DCN_DP = "dcn_dp"
 AXIS_PP = "pp"
 AXIS_DP_REPLICATE = "dp_replicate"
 AXIS_DP_SHARD = "dp_shard"
 AXIS_CP = "cp"
 AXIS_TP = "tp"
-MESH_AXES: Tuple[str, ...] = (AXIS_PP, AXIS_DP_REPLICATE, AXIS_DP_SHARD,
-                              AXIS_CP, AXIS_TP)
+MESH_AXES: Tuple[str, ...] = (AXIS_DCN_DP, AXIS_PP, AXIS_DP_REPLICATE,
+                              AXIS_DP_SHARD, AXIS_CP, AXIS_TP)
 
-# Flattened views (reference fsdp2.py:181-221):
-#   dp          = dp_replicate x dp_shard      -> data/batch sharding
-#   dp_shard_cp = dp_shard x cp                -> parameter (FSDP) sharding
-#   dp_cp       = dp_replicate x dp_shard x cp -> loss / token-count reduction
-DP_AXES: Tuple[str, ...] = (AXIS_DP_REPLICATE, AXIS_DP_SHARD)
+# Flattened views (reference fsdp2.py:181-221), extended with the cross-slice
+# dcn_dp axis (which behaves exactly like an extra replicate axis):
+#   dp          = dcn_dp x dp_replicate x dp_shard -> data/batch sharding
+#   dp_shard_cp = dp_shard x cp                    -> parameter (FSDP) sharding
+#   dp_cp       = dcn_dp x dp_replicate x dp_shard x cp
+#                                                  -> loss / token reduction
+DP_AXES: Tuple[str, ...] = (AXIS_DCN_DP, AXIS_DP_REPLICATE, AXIS_DP_SHARD)
 FSDP_AXES: Tuple[str, ...] = (AXIS_DP_SHARD, AXIS_CP)
-LOSS_AXES: Tuple[str, ...] = (AXIS_DP_REPLICATE, AXIS_DP_SHARD, AXIS_CP)
-BATCH_AXES: Tuple[str, ...] = (AXIS_DP_REPLICATE, AXIS_DP_SHARD)
+LOSS_AXES: Tuple[str, ...] = (AXIS_DCN_DP, AXIS_DP_REPLICATE, AXIS_DP_SHARD,
+                              AXIS_CP)
+BATCH_AXES: Tuple[str, ...] = (AXIS_DCN_DP, AXIS_DP_REPLICATE, AXIS_DP_SHARD)
 
 
 @dataclasses.dataclass
@@ -67,6 +84,7 @@ class MeshConfig:
 
     dp_size: Optional[int] = None
     dp_replicate_size: int = 1
+    dcn_dp_size: int = 1      # slices over DCN (hierarchical DP, outermost)
     tp_size: int = 1
     cp_size: int = 1
     pp_size: int = 1          # reserved seam — only 1 is implemented
@@ -93,6 +111,7 @@ class MeshManager:
         self,
         dp_size: Optional[int] = None,
         dp_replicate_size: int = 1,
+        dcn_dp_size: int = 1,
         tp_size: int = 1,
         cp_size: int = 1,
         pp_size: int = 1,
@@ -101,8 +120,27 @@ class MeshManager:
         cp_layout: Optional[str] = None,
         devices: Optional[Sequence[jax.Device]] = None,
         allow_split_physical_axes: bool = True,
+        strict: Optional[bool] = None,
         **_unused,
     ):
+        # Unknown kwargs are tolerated only for reference-YAML compatibility
+        # (FSDP2Manager carries torch-only knobs).  They must never be
+        # SILENT: a ``dcn_dp_size`` misspelling that quietly builds a
+        # single-slice mesh is exactly the failure mode elastic recovery
+        # cannot detect.  Default: warn; under strict config (``strict=True``
+        # or AUTOMODEL_STRICT_CONFIG=1): raise.
+        if _unused:
+            code = type(self).__init__.__code__
+            known = [k for k in code.co_varnames[1:code.co_argcount
+                                                 + code.co_kwonlyargcount]]
+            msg = (f"MeshManager: unknown config key(s) {sorted(_unused)} "
+                   f"ignored (known keys: {sorted(known)})")
+            if strict is None:
+                strict = os.environ.get(
+                    "AUTOMODEL_STRICT_CONFIG", "0") not in ("0", "", "false")
+            if strict:
+                raise TypeError(msg)
+            logger.warning(msg)
         if _none_to(pp_size, 1) != 1:
             raise NotImplementedError(
                 "pipeline parallelism is a reserved seam (pp axis exists, "
@@ -128,7 +166,12 @@ class MeshManager:
         tp_size = _none_to(tp_size, 1)
         cp_size = _none_to(cp_size, 1)
         dp_replicate_size = _none_to(dp_replicate_size, 1)
+        dcn_dp_size = _none_to(dcn_dp_size, 1)
         dp_size = _none_to(dp_size, None)
+        if dcn_dp_size < 1 or world % dcn_dp_size:
+            raise ValueError(
+                f"device count {world} not divisible into "
+                f"dcn_dp_size={dcn_dp_size} slices")
         if dp_size is None:
             denom = tp_size * cp_size
             if world % denom:
@@ -136,37 +179,52 @@ class MeshManager:
                     f"world size {world} not divisible by tp*cp={denom}"
                 )
             dp_size = world // denom
-        if dp_size % dp_replicate_size:
+        # dp_size is the TOTAL data-parallel extent: dcn_dp (across slices)
+        # x dp_replicate x dp_shard (within a slice).
+        if dp_size % (dcn_dp_size * dp_replicate_size):
             raise ValueError(
-                f"dp_size {dp_size} not divisible by dp_replicate_size {dp_replicate_size}"
+                f"dp_size {dp_size} not divisible by dcn_dp_size*"
+                f"dp_replicate_size {dcn_dp_size * dp_replicate_size}"
             )
-        dp_shard = dp_size // dp_replicate_size
-        total = dp_replicate_size * dp_shard * cp_size * tp_size
+        dp_shard = dp_size // (dcn_dp_size * dp_replicate_size)
+        total = dcn_dp_size * dp_replicate_size * dp_shard * cp_size * tp_size
         if total != world:
             raise ValueError(
-                f"mesh {dp_replicate_size}x{dp_shard}x{cp_size}x{tp_size}={total} "
-                f"!= device count {world}"
+                f"mesh {dcn_dp_size}x{dp_replicate_size}x{dp_shard}x"
+                f"{cp_size}x{tp_size}={total} != device count {world}"
             )
 
-        self.shape: Tuple[int, int, int, int] = (
+        self.shape: Tuple[int, int, int, int, int] = (
+            dcn_dp_size,
             dp_replicate_size,
             dp_shard,
             cp_size,
             tp_size,
         )
-        try:
-            from jax.experimental import mesh_utils
+        # Device placement: the dcn_dp axis must map to SLICE boundaries —
+        # slice i owns dev_array[i], so every dense (ICI) collective stays
+        # within one slice and only the dcn_dp grad all-reduce crosses DCN.
+        self._slice_devices: List[List[jax.Device]] = _partition_into_slices(
+            devices, dcn_dp_size)
+        inner_shape = self.shape[1:]
+        slabs = []
+        for slice_devs in self._slice_devices:
+            try:
+                from jax.experimental import mesh_utils
 
-            dev_array = mesh_utils.create_device_mesh(
-                self.shape,
-                devices=devices,
-                allow_split_physical_axes=allow_split_physical_axes,
-            )
-        except Exception:
-            dev_array = np.asarray(devices).reshape(self.shape)
-        # the reserved pp axis rides along at size 1 (outermost): specs
-        # that never name it see identical behavior
-        self.mesh_shape: Tuple[int, ...] = (1,) + self.shape
+                slab = mesh_utils.create_device_mesh(
+                    inner_shape,
+                    devices=slice_devs,
+                    allow_split_physical_axes=allow_split_physical_axes,
+                )
+            except Exception:
+                slab = np.asarray(slice_devs).reshape(inner_shape)
+            slabs.append(slab)
+        dev_array = np.stack(slabs, axis=0)
+        # the reserved pp axis rides along at size 1 (between dcn_dp and the
+        # replicate axis): specs that never name it see identical behavior
+        self.mesh_shape: Tuple[int, ...] = (
+            (dcn_dp_size, 1) + inner_shape)
         self.mesh = Mesh(dev_array.reshape(self.mesh_shape), MESH_AXES)
 
     # -- reference-parity size accessors ----------------------------------
@@ -175,29 +233,76 @@ class MeshManager:
         return int(np.prod(self.shape))
 
     @property
-    def dp_replicate_size(self) -> int:
+    def dcn_dp_size(self) -> int:
         return self.shape[0]
 
     @property
-    def dp_shard_size(self) -> int:
+    def dp_replicate_size(self) -> int:
         return self.shape[1]
 
     @property
-    def cp_size(self) -> int:
+    def dp_shard_size(self) -> int:
         return self.shape[2]
 
     @property
-    def tp_size(self) -> int:
+    def cp_size(self) -> int:
         return self.shape[3]
 
     @property
+    def tp_size(self) -> int:
+        return self.shape[4]
+
+    @property
     def dp_size(self) -> int:
-        return self.shape[0] * self.shape[1]
+        """TOTAL data-parallel extent: dcn_dp x dp_replicate x dp_shard."""
+        return self.shape[0] * self.shape[1] * self.shape[2]
 
     @property
     def loss_reduce_size(self) -> int:
         """Size of the dp_cp group used for global token-count normalization."""
         return self.dp_size * self.cp_size
+
+    # -- multi-slice topology ----------------------------------------------
+    def slice_devices(self, slice_id: int) -> List[jax.Device]:
+        """Devices owned by one ``dcn_dp`` slice (emulated or physical)."""
+        return list(self._slice_devices[slice_id])
+
+    def slice_processes(self, slice_id: int) -> Tuple[int, ...]:
+        """Host process indices whose devices belong to ``slice_id`` — the
+        mapping the elastic detector uses to blame a whole slice for one
+        host's missed heartbeat."""
+        return tuple(sorted({d.process_index
+                             for d in self._slice_devices[slice_id]}))
+
+    def shrink_slices(self, lost_slice: int) -> "MeshManager":
+        """The elastic-recovery mesh: same per-slice geometry, ``dcn_dp-1``
+        slices, built over the SURVIVING slices' devices only.  Raises when
+        there is no slice to lose (``dcn_dp == 1`` is the smallest mesh a
+        run can shrink to)."""
+        n = self.dcn_dp_size
+        if not 0 <= lost_slice < n:
+            raise ValueError(
+                f"lost_slice {lost_slice} out of range for dcn_dp={n}")
+        if n <= 1:
+            raise ValueError(
+                "cannot shrink a single-slice mesh: dcn_dp is already 1 "
+                "(slice loss at dcn_dp=1 is a full-pool loss — resume via "
+                "relaunch, not elastic rebuild)")
+        survivors: List[jax.Device] = []
+        for s in range(n):
+            if s != lost_slice:
+                survivors.extend(self._slice_devices[s])
+        return MeshManager(
+            dcn_dp_size=n - 1,
+            dp_size=(n - 1) * self.dp_replicate_size * self.dp_shard_size,
+            dp_replicate_size=self.dp_replicate_size,
+            tp_size=self.tp_size,
+            cp_size=self.cp_size,
+            sequence_parallel=self.sequence_parallel,
+            expert_parallel=self.expert_parallel,
+            cp_layout=self.cp_layout,
+            devices=survivors,
+        )
 
     def __enter__(self):
         self._ctx = self.mesh
@@ -218,13 +323,41 @@ def _none_to(v, default):
     return int(v)
 
 
+def _partition_into_slices(devices: Sequence[jax.Device],
+                           n_slices: int) -> List[List[jax.Device]]:
+    """Group devices into ``n_slices`` dcn_dp blocks.
+
+    On a real multi-slice pool every device carries a ``slice_index`` and
+    the grouping follows it (a dcn_dp block must be one physical slice so
+    its inner collectives ride ICI).  On single-slice hardware and the
+    CPU/dryrun platform the device list is partitioned contiguously into
+    EMULATED slices — the topology elastic drills shrink."""
+    per_slice = len(devices) // n_slices
+    by_slice: dict = {}
+    for d in devices:
+        by_slice.setdefault(getattr(d, "slice_index", None), []).append(d)
+    slice_ids = sorted(by_slice, key=lambda s: (s is None, s))
+    if len(slice_ids) == n_slices and all(
+            len(by_slice[s]) == per_slice for s in slice_ids):
+        return [by_slice[s] for s in slice_ids]
+    if len(slice_ids) > 1 and n_slices > 1:
+        raise ValueError(
+            f"dcn_dp_size={n_slices} does not match the physical slice "
+            f"topology {{slice: n_devices}} = "
+            f"{ {s: len(v) for s, v in by_slice.items()} }")
+    return [list(devices[i * per_slice:(i + 1) * per_slice])
+            for i in range(n_slices)]
+
+
 def build_mesh(cfg=None, **kwargs) -> MeshManager:
-    """Convenience builder from a ConfigNode or kwargs."""
+    """Convenience builder from a ConfigNode or kwargs.
+
+    Every cfg key is FORWARDED (minus ``_target_``) so MeshManager's
+    unknown-kwarg guard sees misspellings — a whitelist here would silently
+    drop a ``dcn_dp_size`` typo before the guard could warn/raise."""
     if cfg is not None:
-        fields = {k: cfg.get(k) for k in (
-            "dp_size", "dp_replicate_size", "tp_size", "cp_size", "pp_size",
-            "sequence_parallel", "cp_layout"
-        ) if k in cfg}
+        raw = cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg)
+        fields = {k: v for k, v in raw.items() if k != "_target_"}
         fields.update(kwargs)
         kwargs = fields
     return MeshManager(**kwargs)

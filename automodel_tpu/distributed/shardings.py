@@ -39,9 +39,11 @@ from jax.sharding import PartitionSpec as P
 
 from automodel_tpu.distributed.mesh import (
     AXIS_CP,
+    AXIS_DCN_DP,
     AXIS_DP_REPLICATE,
     AXIS_DP_SHARD,
     AXIS_TP,
+    BATCH_AXES,
     FSDP_AXES,
     MeshManager,
 )
@@ -80,7 +82,10 @@ def default_rules(sequence_parallel: bool = False,
         "experts": (AXIS_TP,) if expert_parallel else None,
         "expert_mlp": None if expert_parallel else (AXIS_TP,),
         # -- activation axes --
-        "act_batch": (AXIS_DP_REPLICATE, AXIS_DP_SHARD),
+        # Batch-ish axes include the cross-slice dcn_dp axis: batches shard
+        # across slices (hierarchical DP) while no PARAMETER axis ever names
+        # it — the cross-slice traffic is exactly the grad all-reduce.
+        "act_batch": (AXIS_DCN_DP, AXIS_DP_REPLICATE, AXIS_DP_SHARD),
         "act_seq": (AXIS_CP, AXIS_TP) if sequence_parallel else (AXIS_CP,),
         # Logits: vocab goes over tp (vocab-parallel lm_head), so the seq dim
         # must stay off tp even under SP (Megatron all-gathers before lm_head).
@@ -93,7 +98,8 @@ def default_rules(sequence_parallel: bool = False,
         # [T*k(+pad), ...] buffers (ops/moe.py::sorted_expert_ffn), whose
         # FFN intermediate additionally carries "expert_mlp" so non-EP
         # meshes shard it over tp.
-        "act_tokens": (AXIS_DP_REPLICATE, AXIS_DP_SHARD, AXIS_CP),
+        "act_tokens": (AXIS_DCN_DP, AXIS_DP_REPLICATE, AXIS_DP_SHARD,
+                       AXIS_CP),
     }
     return rules
 
@@ -153,13 +159,14 @@ def param_shardings(model, mesh: Mesh, rules: Optional[Rules] = None) -> Any:
 # Batch sharding
 # ---------------------------------------------------------------------------
 def batch_spec() -> P:
-    """[B, S] batch arrays: batch over dp axes, sequence over cp.
+    """[B, S] batch arrays: batch over dp axes (incl. the cross-slice
+    ``dcn_dp``), sequence over cp.
 
     Reference parity: StatefulDistributedSampler shards batch over the ``dp``
     mesh (``recipes/llm/train_ft.py:283-307``) and ``context_parallel`` shards
     the seq dim over ``cp`` (``distributed/cp_utils.py:102-149``).
     """
-    return P((AXIS_DP_REPLICATE, AXIS_DP_SHARD), AXIS_CP)
+    return P(BATCH_AXES, AXIS_CP)
 
 
 def batch_shardings(mesh: Mesh, batch: Optional[Any] = None) -> Any:
@@ -181,7 +188,7 @@ def batch_rows_by_process(mesh: Mesh, global_batch: int):
     """
     import numpy as np
 
-    sh = NamedSharding(mesh, P((AXIS_DP_REPLICATE, AXIS_DP_SHARD)))
+    sh = NamedSharding(mesh, P(BATCH_AXES))
     by_proc: dict = {}
     for dev, idx in sh.devices_indices_map((global_batch,)).items():
         rows = by_proc.setdefault(dev.process_index, set())

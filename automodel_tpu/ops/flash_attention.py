@@ -136,15 +136,22 @@ def sharded_flash_attention(
     segment_ids=None,
     attention_mask=None,
     scale=None,
-    batch_axes=("dp_replicate", "dp_shard"),
+    batch_axes=None,
     head_axis: str = "tp",
 ):
     """shard_map wrapper: a pallas_call must run per-shard under GSPMD, so
-    batch goes over dp and heads over tp; seq stays whole (cp=1 path — cp>1
-    routes to ring attention instead)."""
+    batch goes over dp (incl. the cross-slice dcn_dp axis) and heads over
+    tp; seq stays whole (cp=1 path — cp>1 routes to ring attention
+    instead).  ``batch_axes=None`` (default) uses the dp-family axes
+    PRESENT in the mesh; an explicit tuple is used verbatim, so a typo'd
+    axis still fails loudly at spec resolution."""
     from automodel_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
+    from automodel_tpu.distributed.mesh import BATCH_AXES
+
+    if batch_axes is None:
+        batch_axes = tuple(a for a in BATCH_AXES if a in mesh.shape)
     qspec = P(tuple(batch_axes), None, head_axis, None)
     kvspec = P(tuple(batch_axes), None, head_axis, None)
     sspec = P(tuple(batch_axes), None)

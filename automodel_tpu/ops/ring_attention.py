@@ -298,17 +298,24 @@ def sharded_ring_attention(
     local_window_size=None,
     logits_soft_cap=None,
     layout: str = "contiguous",
-    batch_axes=("dp_replicate", "dp_shard"),
+    batch_axes=None,
     seq_axis: str = "cp",
     head_axis: str = "tp",
 ):
     """shard_map wrapper: [B, S, H, D] global arrays with S sharded over cp,
-    heads over tp, batch over dp -> ring attention per shard.  The caller is
-    responsible for the arrays already being in ``layout`` order along S
-    (the recipes permute batches host-side; see ``ops/zigzag.py``)."""
+    heads over tp, batch over dp (incl. the cross-slice dcn_dp axis) ->
+    ring attention per shard.  The caller is responsible for the arrays
+    already being in ``layout`` order along S (the recipes permute batches
+    host-side; see ``ops/zigzag.py``).  ``batch_axes=None`` (default) uses
+    the dp-family axes PRESENT in the mesh; an explicit tuple is used
+    verbatim (typos fail loudly)."""
     from automodel_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
+    from automodel_tpu.distributed.mesh import BATCH_AXES
+
+    if batch_axes is None:
+        batch_axes = tuple(a for a in BATCH_AXES if a in mesh.shape)
     qspec = P(tuple(batch_axes), seq_axis, head_axis, None)
     sspec = P(tuple(batch_axes), seq_axis)
 

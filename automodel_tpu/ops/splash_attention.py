@@ -241,21 +241,26 @@ def sharded_splash_attention(
     scale=None,
     logits_soft_cap=None,
     local_window_size: Optional[int] = None,
-    batch_axes=("dp_replicate", "dp_shard"),
+    batch_axes=None,
     head_axis: str = "tp",
 ):
     """shard_map wrapper: a pallas_call runs per-shard under GSPMD — batch
-    over dp, heads over tp, sequence whole (cp>1 routes to ring attention
-    before reaching here)."""
+    over dp (incl. the cross-slice dcn_dp axis), heads over tp, sequence
+    whole (cp>1 routes to ring attention before reaching here).
+    ``batch_axes=None`` (default) uses the dp-family axes PRESENT in the
+    mesh; an explicit tuple is used verbatim (typos fail loudly)."""
     from automodel_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
+    from automodel_tpu.distributed.mesh import BATCH_AXES
     from automodel_tpu.ops.attention import fold_padding_into_segments
 
     B, S = q.shape[:2]
     segment_ids = fold_padding_into_segments((B, S), segment_ids,
                                              attention_mask)
 
+    if batch_axes is None:
+        batch_axes = tuple(a for a in BATCH_AXES if a in mesh.shape)
     qspec = P(tuple(batch_axes), None, head_axis, None)
     sspec = P(tuple(batch_axes), None)
 

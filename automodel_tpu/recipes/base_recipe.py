@@ -150,6 +150,134 @@ class BaseRecipe:
             return contextlib.nullcontext()
         return timers.record(name)
 
+    # -- elastic recovery ----------------------------------------------------
+    def _rebuild_parallelism(self, mesh_manager) -> None:
+        """Rebuild plan + step functions for a NEW mesh (elastic shrink).
+
+        Recipes register ``self._parallelism_builder`` — a callable
+        ``mesh_manager -> (plan, step_fns)`` capturing their model /
+        optimizer / loss / masking choices — at setup; this hook applies it
+        and swaps in ABSTRACT (ShapeDtypeStruct) params/opt-state carrying
+        the new shardings, ready for the mesh-reshape checkpoint restore.
+        """
+        builder = getattr(self, "_parallelism_builder", None)
+        if builder is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} cannot rebuild after a slice loss: "
+                "set self._parallelism_builder = (mesh_manager -> "
+                "(plan, step_fns)) during setup")
+        plan, fns = builder(mesh_manager)
+        self.plan, self.step_fns = plan, fns
+        self.param_sharding = plan.param_sharding
+        abs_params = jax.tree.map(
+            lambda a, sh: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh),
+            jax.eval_shape(self.model.init, jax.random.key(0)),
+            plan.param_sharding)
+        self.params = abs_params
+        abs_opt = jax.eval_shape(fns.init_opt_state, abs_params)
+        self.opt_state = jax.tree.map(
+            lambda a, sh: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh),
+            abs_opt, fns.opt_state_sharding)
+
+    def recover_from_slice_loss(self, event) -> Dict[str, Any]:
+        """Slice loss -> running again, with NO operator action:
+
+        1. **Shrink**: rebuild the mesh at ``dcn_dp - 1`` over the surviving
+           slices' devices (``MeshManager.shrink_slices``) and rebuild the
+           plan/step functions on it (:meth:`_rebuild_parallelism`).
+        2. **Restore**: resume params/optimizer/host state from the last
+           COMMITTED checkpoint via the existing mesh-reshape restore path
+           (Orbax global arrays re-read against the new shardings).  An
+           in-flight background save is joined with its error demoted to a
+           log — its snapshot predates the failure and may never commit;
+           committed-ness remains the only currency.
+        3. **Rescale**: apply the documented deterministic rule
+           (``utils/elastic.rescale_for_slice_loss``): grad-accumulation
+           steps multiply by ``old/gcd(old,new)`` so tokens-per-optimizer-
+           step — and therefore the LR schedule and per-token LR — are
+           unchanged whenever ``new`` divides ``old``; any residual batch
+           ratio folds into a linear LR scale, keeping per-token LR exact.
+
+        Wall time is charged to the ``elastic_rebuild`` timer (goodput
+        accounting, ``training/timers.py``).  Returns a summary dict
+        ``{lost_slice, new_dcn_dp, restored_from, restored_step,
+        accum_factor, lr_scale}``.
+        """
+        from automodel_tpu.utils.elastic import (
+            SliceLostError,
+            rescale_for_slice_loss,
+        )
+
+        lost = (event.slice_id if isinstance(event, SliceLostError)
+                else int(event))
+        with self._record_timer("elastic_rebuild"):
+            # the in-flight snapshot predates the loss; never let its
+            # failure mask the recovery (committed state is the fallback)
+            self.join_pending_save(raise_error=False)
+            old_mm = self.mesh_manager
+            # shrink FIRST: a slice loss at dcn_dp=1 must surface the
+            # designed full-pool-loss error, not a rescale-domain ValueError
+            new_mm = old_mm.shrink_slices(lost)
+            self.mesh_manager = new_mm
+            self._rebuild_parallelism(new_mm)
+            # shardings changed: re-probe async-save feasibility next save
+            object.__setattr__(self, "_async_snapshot_ok", None)
+            restored = self.load_checkpoint()
+            if restored is None:
+                raise ckpt.CheckpointSaveError(
+                    f"slice {lost} lost but no committed checkpoint exists "
+                    "to resume from — enable checkpointing for elastic runs")
+            # Rescale AFTER restore, from the regime the CHECKPOINT was
+            # saved under (elastic_state rode the restore): the LR fields
+            # just rewound to checkpoint values, so pairing them with a
+            # checkpoint-relative accumulation factor keeps the two
+            # consistent even when a SECOND slice loss lands before any
+            # new checkpoint — an incremental old-mesh-relative factor
+            # would compound across recoveries while the LR rewound.
+            es = getattr(self, "elastic_state", None)
+            ckpt_slices = es.dcn_dp if es is not None else old_mm.dcn_dp_size
+            sched = getattr(self, "step_scheduler", None)
+            ckpt_accum = (es.grad_acc_steps if es is not None
+                          else getattr(sched, "grad_acc_steps", 1))
+            if new_mm.dcn_dp_size < ckpt_slices:
+                rescale = rescale_for_slice_loss(
+                    ckpt_slices, new_mm.dcn_dp_size)
+            else:
+                # checkpoint already saved at (or below) the new width: the
+                # restored regime IS the target regime, identity rescale
+                from automodel_tpu.utils.elastic import Rescale
+
+                rescale = Rescale(old_slices=ckpt_slices,
+                                  new_slices=new_mm.dcn_dp_size)
+            if sched is not None and hasattr(sched, "grad_acc_steps"):
+                sched.grad_acc_steps = ckpt_accum * rescale.accum_factor
+            lr_sched = getattr(self, "lr_scheduler", None)
+            if lr_sched is not None and rescale.lr_scale != 1.0:
+                for attr in ("init_lr", "max_lr", "min_lr"):
+                    setattr(lr_sched, attr,
+                            getattr(lr_sched, attr) * rescale.lr_scale)
+                lr_sched.step(0)  # refresh current_lr under the new scale
+            if es is not None:
+                # the NEXT checkpoint must record the post-recovery regime
+                es.dcn_dp = new_mm.dcn_dp_size
+                es.grad_acc_steps = getattr(sched, "grad_acc_steps",
+                                            es.grad_acc_steps)
+        info = {
+            "lost_slice": lost,
+            "new_dcn_dp": new_mm.dcn_dp_size,
+            "restored_from": restored,
+            "restored_step": getattr(getattr(self, "step_scheduler", None),
+                                     "step", None),
+            "accum_factor": rescale.accum_factor,
+            "lr_scale": rescale.lr_scale,
+        }
+        logger.warning(
+            "elastic recovery: slice %d lost -> mesh rebuilt at dcn_dp=%d, "
+            "grad_acc x%d, lr x%.4g, resumed from %s",
+            lost, new_mm.dcn_dp_size, rescale.accum_factor, rescale.lr_scale,
+            restored)
+        return info
+
     # -- save ----------------------------------------------------------------
     def save_checkpoint(self, epoch: int, step: int) -> str:
         """Crash-safe save: stage -> write -> barrier -> manifest -> rename.

@@ -342,6 +342,17 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         self.step_fns = build_train_step(
             self.model, self.optimizer, loss_fn=self.loss_fn, plan=self.plan,
             trainable_mask=step_mask, **step_kwargs)
+        # Elastic recovery hook: how to rebuild plan + step functions on a
+        # SHRUNK mesh after a slice loss (BaseRecipe.recover_from_slice_loss
+        # -> _rebuild_parallelism).  Captures this setup's masking/dtype
+        # choices so the rebuilt step is the same program on fewer devices.
+        def _parallelism_builder(mm):
+            plan = build_parallel_plan(self.model, mm)
+            return plan, build_train_step(
+                self.model, self.optimizer, loss_fn=self.loss_fn, plan=plan,
+                trainable_mask=step_mask, **step_kwargs)
+
+        self._parallelism_builder = _parallelism_builder
 
         # Params: stream HF weights into shards, or fresh init
         ckpt_dir = getattr(self.model, "checkpoint_dir", None)
@@ -395,6 +406,13 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         total = self._total_optim_steps(ss_kwargs)
         self.lr_scheduler = build_lr_scheduler(
             cfg.get("lr_scheduler"), cfg.get("optimizer"), total)
+        # Checkpointed regime record for elastic recovery: the rescale after
+        # a slice loss is computed from the regime the RESTORED checkpoint
+        # was saved under (utils/elastic.ElasticState).
+        from automodel_tpu.utils.elastic import ElasticState
+
+        self.elastic_state = ElasticState(
+            self.mesh_manager.dcn_dp_size, self.step_scheduler.grad_acc_steps)
 
         # Kernel block-size autotune (after the compile cache so the
         # winner cache lands beside it; before the first train-step trace
@@ -416,6 +434,18 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         self.checkpoint_config = build_checkpoint_config(cfg.get("checkpoint"))
         if self.peft_config is not None:
             self.checkpoint_config.is_peft = True
+        # Elastic multi-slice recovery (``elastic:`` YAML section): slice-
+        # loss detection + in-place shrink/restore (utils/elastic.py).
+        from automodel_tpu.utils.elastic import build_elastic_config
+
+        self.elastic_config = build_elastic_config(cfg.get("elastic"))
+        if (self.elastic_config.enabled
+                and self.mesh_manager.dcn_dp_size < 2):
+            logger.warning(
+                "elastic.enabled with dcn_dp_size=%d: slice loss is only "
+                "recoverable in-place with >= 2 slices (a single-slice "
+                "loss is a full-pool loss — resume happens via relaunch)",
+                self.mesh_manager.dcn_dp_size)
         self.timers = Timers()
         self.profiling = build_profiling_config(cfg.get("profiling"))
         self._tracing = False
@@ -821,6 +851,10 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         sched = self.step_scheduler
         is_main = self.dist_info.is_main
         prof = self.profiling
+        from automodel_tpu.utils.elastic import (
+            ElasticCoordinator,
+            SliceLostError,
+        )
         from automodel_tpu.utils.sig_utils import (
             DistributedSignalHandler,
             get_signal_name,
@@ -831,9 +865,65 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         # first interval's window is zero-length and ckpt_stall_fraction
         # reports 0 even when a save stalled inside it
         self._prof_window_t0 = time.perf_counter()
+        ecfg = self.elastic_config
+        recoveries = 0
+        import signal as _signal
+
         try:
-            with DistributedSignalHandler() as preempt:
-                self._train_epochs(sched, is_main, prof, preempt)
+            # SIGTERM (pool preemption) + SIGINT (operator ^C) both take
+            # the grace-window save path; a SECOND ^C still hard-aborts
+            # (sig_utils chains the stdlib handler on repeat)
+            with DistributedSignalHandler(
+                    (_signal.SIGTERM, _signal.SIGINT)) as preempt:
+                self._elastic = (
+                    ElasticCoordinator(
+                        self.mesh_manager,
+                        heartbeat_timeout_s=ecfg.heartbeat_timeout_s,
+                        signal_handler=preempt)
+                    if ecfg.enabled else None)
+                while True:
+                    try:
+                        self._train_epochs(sched, is_main, prof, preempt)
+                        break
+                    except SliceLostError as e:
+                        recoveries += 1
+                        if (self._elastic is None
+                                or recoveries > ecfg.max_recoveries):
+                            raise
+                        if e.local:
+                            # THIS host's slice is the lost one: the shrunk
+                            # mesh contains none of its devices — in-place
+                            # recovery is impossible; exit so the relaunch
+                            # path (resume-from-last-committed) takes over
+                            raise
+                        # the step the failure was DETECTED at (sched.step
+                        # may sit one ahead under the async input lookahead)
+                        failed_step = (e.detected_at_step
+                                       if e.detected_at_step >= 0
+                                       else sched.step)
+                        logger.warning(
+                            "slice loss detected at step %d: %s — "
+                            "recovering (%d/%d)", failed_step, e,
+                            recoveries, ecfg.max_recoveries)
+                        # goodput: the failure went unseen for at most one
+                        # poll interval; rebuild+restore times itself
+                        self.timers("elastic_detect").add(
+                            self._elastic.detect_latency_s())
+                        if getattr(self, "_replay_until", None) is not None:
+                            # a second loss DURING replay: bank the partial
+                            # replay time before restarting the window
+                            self.timers("elastic_replay").stop()
+                            self._replay_until = None
+                        self.recover_from_slice_loss(e)
+                        self._post_slice_recovery()
+                        self._elastic.mesh_manager = self.mesh_manager
+                        if sched.step < failed_step:
+                            # goodput: steps between the restored checkpoint
+                            # and the failure are RE-trained — pure loss;
+                            # the timer closes in _post_step when the run
+                            # re-reaches the failed step
+                            self._replay_until = failed_step
+                            self.timers("elastic_replay").start()
         except BaseException:
             # teardown must not mask the propagating failure with a
             # background-save error — log it instead
@@ -845,9 +935,29 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         if self.preempted and is_main:
             logger.warning(
                 "preemption (%s) handled at step %d: %s, exiting cleanly",
-                get_signal_name(preempt.sig), sched.step,
+                get_signal_name(preempt.received_signal or preempt.sig),
+                sched.step,
                 "checkpoint saved" if getattr(self, "_preempt_saved", False)
                 else "checkpointing disabled, nothing saved")
+
+    def _post_slice_recovery(self):
+        """Recipe half of elastic recovery: rebuild the INPUT pipeline for
+        the shrunk mesh.  The rescale rule pins the per-device batch — the
+        global microbatch is ``local_batch_size x dp_size`` and ``dp_size``
+        just shrank — so the loader is rebuilt at the new width and resumed
+        from the restored sample index (state is a SAMPLE count, so it is
+        batch-size-independent)."""
+        ss_cfg = self.cfg.get("step_scheduler")
+        local_bs = int(ss_cfg.get("local_batch_size", 1)) if ss_cfg else 1
+        old_loader = self.dataloader
+        state = (old_loader.state_dict()
+                 if hasattr(old_loader, "state_dict") else None)
+        if hasattr(old_loader, "close"):
+            old_loader.close()
+        self._setup_data(local_bs * self.mesh_manager.dp_size)
+        if state is not None and hasattr(self.dataloader, "load_state_dict"):
+            self.dataloader.load_state_dict(state)
+        self.step_scheduler.set_dataloader(self.dataloader)
 
     def _pull_staged(self, groups):
         """Pull the next grad-acc group and immediately issue its device
@@ -998,12 +1108,22 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             self.flush_metrics()
             self.save_checkpoint(epoch, step)
             self._last_ckpt_step = step
-        # Preemption poll: signals_received is COLLECTIVE, so all
-        # hosts must call it on the same steps — single-process polls
-        # every step (free); multi-host every 10th (the per-step
-        # allgather would serialize async dispatch; preemption grace
-        # windows are tens of seconds, so a few steps of latency is
-        # fine) and at checkpoint boundaries.
+        # Close the elastic replay window: once the run has re-reached the
+        # step it died at, the re-trained steps stop counting as goodput
+        # loss (timer opened by the recovery loop).
+        if (getattr(self, "_replay_until", None) is not None
+                and step >= self._replay_until):
+            self.timers("elastic_replay").stop()
+            self._replay_until = None
+        # Preemption poll FIRST (before the elastic health poll): a signal
+        # this host already caught must take the grace-window save path —
+        # under a full-pool preemption every slice looks unhealthy and the
+        # elastic verdict would otherwise misread it as a slice failure.
+        # signals_received is COLLECTIVE, so all hosts must call it on the
+        # same steps — single-process polls every step (free); multi-host
+        # every 10th (the per-step allgather would serialize async
+        # dispatch; preemption grace windows are tens of seconds, so a few
+        # steps of latency is fine) and at checkpoint boundaries.
         poll = (jax.process_count() == 1 or step % 10 == 0 or is_ckpt)
         if preempt is not None and poll and preempt.signals_received():
             self.flush_metrics()
@@ -1053,6 +1173,16 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             self.preempted = True
             self._stop_trace()  # may stop inside an open window
             return True
+        # Elastic slice-health poll (COLLECTIVE like the preemption poll:
+        # fixed step cadence so every host calls it together; it runs
+        # AFTER the preemption poll so a locally-caught signal takes the
+        # grace save, not a slice verdict).  A verdict raises
+        # SliceLostError, which unwinds to the recovery loop in
+        # run_train_validation_loop.
+        el = getattr(self, "_elastic", None)
+        if el is not None and step % max(
+                self.elastic_config.heartbeat_interval_steps, 1) == 0:
+            el.poll(step)
         return False
 
     def _train_epochs(self, sched, is_main, prof, preempt=None):

@@ -63,6 +63,34 @@ def ckpt_stall_fraction(elapsed: Dict[str, float], window: float) -> float:
     return min(elapsed.get("ckpt_stall", 0.0) / window, 1.0)
 
 
+# Elastic-recovery timers (utils/elastic.py + recipes/base_recipe.py):
+# ``elastic_detect`` is the wall time from a slice actually dying to the
+# coordinator's verdict (heartbeat/poll latency); ``elastic_rebuild`` covers
+# the mesh shrink + plan/step rebuild + restore from the last committed
+# checkpoint; ``elastic_replay`` is the re-training of steps that were lost
+# between that checkpoint and the failure.  None of these produce training
+# progress — their sum over a window is the goodput loss a slice failure
+# cost.
+ELASTIC_TIMERS = ("elastic_detect", "elastic_rebuild", "elastic_replay")
+
+
+def goodput_fraction(elapsed: Dict[str, float], window: float) -> float:
+    """Fraction of a wall-clock window spent making FORWARD progress:
+    1 - (detection + rebuild + replay time) / window.  The elastic bench
+    secondary reports this next to ``recovery_time_s`` — the two numbers
+    MaxText-style goodput accounting tracks for multi-slice runs."""
+    if window <= 0:
+        return 1.0
+    lost = sum(elapsed.get(name, 0.0) for name in ELASTIC_TIMERS)
+    return max(0.0, min(1.0, 1.0 - lost / window))
+
+
+def recovery_time_s(elapsed: Dict[str, float]) -> float:
+    """Total seconds one recovery consumed (detect + rebuild + replay) —
+    the bounded-recovery-time number the elastic acceptance bar pins."""
+    return sum(elapsed.get(name, 0.0) for name in ELASTIC_TIMERS)
+
+
 @dataclasses.dataclass
 class ProfilingConfig:
     """``profiling:`` YAML section — wires :class:`Timers` into the hot loop.
@@ -150,6 +178,16 @@ class _Timer:
     def mean(self) -> float:
         with self._lock:
             return float(np.mean(self._history)) if self._history else 0.0
+
+    def add(self, seconds: float) -> None:
+        """Credit an externally-measured interval (e.g. the elastic
+        detector's poll-gap latency — wall time that elapsed before any
+        timer could be running)."""
+        if seconds <= 0:
+            return
+        with self._lock:
+            self._elapsed += seconds
+            self._history.append(seconds)
 
     def discard(self) -> None:
         """Abandon a running interval without recording it (e.g. a data-wait

@@ -14,43 +14,146 @@ import contextlib
 import itertools
 import logging
 import threading
+from typing import Optional
 
 import jax
 
 logger = logging.getLogger(__name__)
 
 
-def barrier(tag: str) -> None:
+class CollectiveTimeout(TimeoutError):
+    """A bounded cross-host wait expired: a peer never reached the barrier/
+    vote named by ``tag`` — the signature of a dead or preempted host.  The
+    elastic detector (``utils/elastic.py``) depends on this surfacing as an
+    exception that NAMES the collective instead of hanging forever."""
+
+    def __init__(self, tag: str, timeout_s: float, detail: str = ""):
+        self.tag = tag
+        self.timeout_s = timeout_s
+        super().__init__(
+            f"collective {tag!r} timed out after {timeout_s:.1f}s"
+            + (f": {detail}" if detail else ""))
+
+
+def _kv_client():
+    """The jax.distributed coordination-service client (None outside an
+    initialized multi-process runtime)."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # pragma: no cover - layout differs across jax
+        return None
+
+
+def _is_timeout_error(e: Exception) -> bool:
+    """Whether a coordination-service error is a DEADLINE expiry (a dead
+    peer) vs some other failure (tag reuse, connection loss, protocol
+    bug).  Only the former may become :class:`CollectiveTimeout` — the
+    elastic detector treats CollectiveTimeout as host death, so
+    misclassifying a programming error would trigger a spurious shrink."""
+    text = str(e).lower()
+    return ("deadline" in text or "timeout" in text or "timed out"
+            in text)
+
+
+def barrier(tag: str, timeout: Optional[float] = None) -> None:
     """Cross-process sync point (no-op single-process).  COLLECTIVE: every
     process must reach it with the same tag — the checkpoint commit protocol
-    uses it to order "all writers finished" before "process 0 renames"."""
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    uses it to order "all writers finished" before "process 0 renames".
 
-        multihost_utils.sync_global_devices(tag)
+    ``timeout`` (seconds) bounds the wait: instead of hanging forever on a
+    dead peer, raises :class:`CollectiveTimeout` naming the tag.  Bounded
+    waits route through the coordination service's KV-store barrier (the
+    only primitive with a deadline); unbounded waits keep the device-level
+    ``sync_global_devices``.  A bounded barrier tag is SINGLE-USE per
+    distinct tag (KV barriers cannot be re-waited) — callers own tag
+    uniqueness, e.g. by suffixing a sequence number."""
+    if jax.process_count() <= 1:
+        return
+    if timeout is not None:
+        client = _kv_client()
+        if client is not None:
+            try:
+                client.wait_at_barrier(tag, int(timeout * 1000))
+                return
+            except Exception as e:
+                if _is_timeout_error(e):
+                    raise CollectiveTimeout(tag, timeout, str(e)) from e
+                raise
+        logger.warning(
+            "barrier %r: no coordination client for a bounded wait; "
+            "falling back to the unbounded device barrier", tag)
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
 
 
-def all_hosts_ok(ok: bool, tag: str = "all_hosts_ok") -> bool:
+def all_hosts_ok(ok: bool, tag: str = "all_hosts_ok",
+                 timeout: Optional[float] = None) -> bool:
     """True iff EVERY process reports ``ok``.  COLLECTIVE: all processes
     must call it (so it also acts as a sync point).  The checkpoint save
     path uses it to agree on aborting a commit when any host's I/O failed —
     the failing host catches its error and votes instead of raising past a
     barrier, which would leave peers hanging in it.  ``tag`` names the vote
-    in the failure log (the allgather itself carries no tag)."""
-    if jax.process_count() == 1:
+    in the failure log (the allgather itself carries no tag).
+
+    ``timeout`` (seconds) bounds the wait via the KV-store vote path and
+    raises :class:`CollectiveTimeout` naming the tag when a peer never
+    votes — a dead host must become a detectable event, not a hang (the
+    elastic detector's contract).  Like bounded :func:`barrier` tags, a
+    bounded vote tag is single-use."""
+    if jax.process_count() <= 1:
         return bool(ok)
+    if timeout is not None:
+        client = _kv_client()
+        if client is not None:
+            return _kv_vote(client, ok, tag, timeout)
+        logger.warning(
+            "all_hosts_ok %r: no coordination client for a bounded wait; "
+            "falling back to the unbounded device vote", tag)
     import numpy as np
     from jax.experimental import multihost_utils
 
     flags = multihost_utils.process_allgather(np.asarray([bool(ok)]))
     if not np.all(flags):
-        import logging
-
-        logging.getLogger(__name__).warning(
+        logger.warning(
             "collective vote %r failed on process(es) %s",
             tag, np.nonzero(~flags.reshape(-1))[0].tolist())
         return False
     return True
+
+
+def _kv_vote(client, ok: bool, tag: str, timeout: float) -> bool:
+    """One KV-store vote round with a deadline (shared by the module-level
+    bounded :func:`all_hosts_ok` and :class:`CollectiveNamespace`)."""
+    client.key_value_set(f"{tag}/p{jax.process_index()}", "1" if ok else "0")
+    try:
+        # the barrier orders every vote before any read
+        client.wait_at_barrier(tag + ".votes_in", int(timeout * 1000))
+    except Exception as e:
+        if _is_timeout_error(e):
+            raise CollectiveTimeout(tag, timeout, str(e)) from e
+        raise
+    flags = client.key_value_dir_get(f"{tag}/")
+    bad = sorted(k for k, v in flags if v != "1")
+    if bad:
+        logger.warning("collective vote %r failed on %s", tag, bad)
+    # one more sync before cleanup so no host deletes keys a slow peer has
+    # not read yet; deletion is best-effort (stale keys are inert as long
+    # as tags are never reused)
+    try:
+        client.wait_at_barrier(tag + ".votes_read", int(timeout * 1000))
+    except Exception as e:
+        if _is_timeout_error(e):
+            raise CollectiveTimeout(tag, timeout, str(e)) from e
+        raise
+    if jax.process_index() == 0:
+        try:
+            client.key_value_delete(f"{tag}/")
+        except Exception:  # pragma: no cover
+            pass
+    return not bad
 
 
 class CollectiveNamespace:
@@ -95,12 +198,7 @@ class CollectiveNamespace:
 
     @staticmethod
     def _client():
-        try:
-            from jax._src import distributed
-
-            return distributed.global_state.client
-        except Exception:  # pragma: no cover - layout differs across jax
-            return None
+        return _kv_client()
 
     def _fallback(self) -> bool:
         if not self._warned:
@@ -115,20 +213,34 @@ class CollectiveNamespace:
         with self._lock:
             return f"{self.name}/{next(self._seq)}/{tag}"
 
-    def barrier(self, tag: str) -> None:
-        """KV-store sync point; same contract as module-level :func:`barrier`."""
+    def barrier(self, tag: str, timeout: Optional[float] = None) -> None:
+        """KV-store sync point; same contract as module-level :func:`barrier`.
+        An expired deadline (``timeout`` seconds, default the generous class
+        ceiling) raises :class:`CollectiveTimeout` naming the namespaced
+        tag — a dead peer surfaces as a typed event, never a silent hang."""
         if jax.process_count() == 1:
             return
         client = self._client()
         key = self._next_key(tag)
+        timeout_ms = (self.timeout_ms if timeout is None
+                      else int(timeout * 1000))
         if client is None:
             self._fallback()
             return barrier(key)
-        client.wait_at_barrier(key, self.timeout_ms)
+        try:
+            client.wait_at_barrier(key, timeout_ms)
+        except Exception as e:
+            if _is_timeout_error(e):
+                raise CollectiveTimeout(key, timeout_ms / 1000.0,
+                                        str(e)) from e
+            raise
 
-    def all_hosts_ok(self, ok: bool, tag: str = "all_hosts_ok") -> bool:
+    def all_hosts_ok(self, ok: bool, tag: str = "all_hosts_ok",
+                     timeout: Optional[float] = None) -> bool:
         """True iff EVERY process reports ``ok`` (KV-store vote); same
-        contract as module-level :func:`all_hosts_ok`."""
+        contract as module-level :func:`all_hosts_ok`.  The sequence counter
+        guarantees single-use tags, so the bounded vote is always safe; a
+        peer missing the deadline raises :class:`CollectiveTimeout`."""
         if jax.process_count() == 1:
             return bool(ok)
         client = self._client()
@@ -136,24 +248,9 @@ class CollectiveNamespace:
         if client is None:
             self._fallback()
             return all_hosts_ok(ok, key)
-        client.key_value_set(f"{key}/p{jax.process_index()}",
-                             "1" if ok else "0")
-        # the barrier orders every vote before any read
-        client.wait_at_barrier(key + ".votes_in", self.timeout_ms)
-        flags = client.key_value_dir_get(f"{key}/")
-        bad = sorted(k for k, v in flags if v != "1")
-        if bad:
-            logger.warning("collective vote %r failed on %s", key, bad)
-        # one more sync before cleanup so no host deletes keys a slow peer
-        # has not read yet; deletion is best-effort (stale keys are inert —
-        # the sequence counter never reuses a key)
-        client.wait_at_barrier(key + ".votes_read", self.timeout_ms)
-        if jax.process_index() == 0:
-            try:
-                client.key_value_delete(f"{key}/")
-            except Exception:  # pragma: no cover
-                pass
-        return not bad
+        timeout_s = (self.timeout_ms / 1000.0 if timeout is None
+                     else float(timeout))
+        return _kv_vote(client, ok, key, timeout_s)
 
 
 @contextlib.contextmanager

@@ -1,0 +1,410 @@
+"""Elastic multi-slice coordination: slice-granular health + the rescale rule.
+
+Production TPU training is N slices over DCN with preemption as a constant.
+This module turns "a slice died" from an operator page into a typed,
+recoverable event:
+
+* :class:`ElasticCoordinator` layers SLICE-granular health on top of the
+  primitives the framework already has — ``DistributedSignalHandler`` (a
+  host that caught SIGTERM/SIGINT is about to vanish) and the
+  ``jax.distributed`` KV store (``utils/dist_utils.CollectiveNamespace``
+  heartbeats on a DEDICATED domain, so detection can never interleave with
+  training-loop or checkpoint collectives).  A missed heartbeat or a
+  preemption signal from ANY host of a slice marks the WHOLE slice lost,
+  and the verdict is voted on the same KV domain so survivors can never
+  split on who died.
+* :class:`SliceLostError` is the event: it names the lost slice and rides
+  the normal exception path up to ``BaseRecipe.recover_from_slice_loss``.
+* :func:`rescale_for_slice_loss` is THE documented deterministic rescale
+  rule (constant per-token LR via accumulation-step increase), pinned by
+  tier-1 tests — see the function docstring.
+
+Drills: the ``slice_loss`` / ``elastic_heartbeat`` fault points
+(``utils/fault_injection.py``) make both failure shapes deterministic on
+the single-process CPU mesh with EMULATED slices — ``raise`` mode models
+surviving hosts detecting a dead peer slice (in-process shrink+resume),
+``:kill`` mode models being ON the dying slice (process vanishes
+mid-anything; the relaunch resumes from the last committed checkpoint).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+from typing import Optional
+
+import jax
+
+from automodel_tpu.utils.dist_utils import CollectiveNamespace, CollectiveTimeout
+from automodel_tpu.utils.fault_injection import InjectedFault, fault_point
+
+logger = logging.getLogger(__name__)
+
+# Env override for which slice a raise-mode ``slice_loss`` drill loses
+# (default: the LAST slice — survivors keep the lowest slice ids, matching
+# how a real pool renumbers after a shrink).
+LOST_SLICE_ENV = "AUTOMODEL_LOST_SLICE"
+
+
+class SliceLostError(RuntimeError):
+    """A whole slice is gone (host death, missed heartbeat, preemption).
+    Carries everything recovery needs; raised from the health poll so it
+    unwinds the hot loop through the normal exception path.
+
+    ``local=True`` means THIS host belongs to the lost slice — in-place
+    recovery is impossible (the shrunk mesh contains none of this host's
+    devices); the recipe re-raises so the process exits and the relaunch
+    path takes over."""
+
+    def __init__(self, slice_id: int, reason: str, detected_at_step: int = -1,
+                 local: bool = False):
+        self.slice_id = slice_id
+        self.reason = reason
+        self.detected_at_step = detected_at_step
+        self.local = local
+        super().__init__(
+            f"slice {slice_id} lost ({reason})"
+            + (f" at step {detected_at_step}" if detected_at_step >= 0
+               else "")
+            + (" [this host's own slice]" if local else ""))
+
+
+@dataclasses.dataclass
+class ElasticConfig:
+    """``elastic:`` YAML section.
+
+    ::
+
+        elastic:
+          enabled: true
+          heartbeat_interval_steps: 10   # poll cadence (collective!)
+          heartbeat_timeout_s: 60.0      # missed deadline => slice lost
+          max_recoveries: 8              # then give up and re-raise
+    """
+
+    enabled: bool = False
+    heartbeat_interval_steps: int = 10
+    heartbeat_timeout_s: float = 60.0
+    max_recoveries: int = 8
+
+
+def build_elastic_config(cfg=None) -> ElasticConfig:
+    """ElasticConfig from a ConfigNode/dict (None -> disabled); presence of
+    the section turns the feature on unless ``enabled`` says otherwise."""
+    if cfg is None:
+        return ElasticConfig()
+    raw = cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg)
+    fields = {f.name for f in dataclasses.fields(ElasticConfig)}
+    unknown = set(raw) - fields
+    if unknown:
+        raise ValueError(f"unknown elastic keys: {sorted(unknown)}")
+    out = ElasticConfig(**raw)
+    if "enabled" not in raw:
+        out.enabled = True
+    return out
+
+
+class ElasticState:
+    """Tracked host-state recording the REGIME a checkpoint was saved under
+    (slice count + grad-accumulation steps).  Recovery computes the rescale
+    from the CHECKPOINT's regime, not the pre-failure mesh's: a second
+    slice loss before any new checkpoint restores the checkpoint's LR
+    fields, and without this record the accumulation factor would compound
+    across recoveries while the LR rewound — silently breaking the
+    constant-per-token-LR rule.  Rides ``BaseRecipe._state_tracked`` like
+    any stateful (saved as ``elastic_state.pt``); checkpoints that predate
+    it leave the setup-time values, which by construction describe the
+    original (pre-any-recovery) regime."""
+
+    def __init__(self, dcn_dp: int = 1, grad_acc_steps: int = 1):
+        self.dcn_dp = int(dcn_dp)
+        self.grad_acc_steps = int(grad_acc_steps)
+
+    def state_dict(self) -> dict:
+        return {"dcn_dp": self.dcn_dp, "grad_acc_steps": self.grad_acc_steps}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.dcn_dp = int(sd["dcn_dp"])
+        self.grad_acc_steps = int(sd["grad_acc_steps"])
+
+
+# ---------------------------------------------------------------------------
+# The deterministic rescale rule
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Rescale:
+    """How a run adapts to ``old_slices -> new_slices``: multiply the
+    grad-accumulation step count by ``accum_factor`` and every learning
+    rate by ``lr_scale``.  Exactly one of the two is != identity."""
+
+    old_slices: int
+    new_slices: int
+    accum_factor: int = 1
+    lr_scale: float = 1.0
+
+
+def rescale_for_slice_loss(old_slices: int, new_slices: int) -> Rescale:
+    """THE documented rescale rule (pinned by tier-1 tests).
+
+    Goal: the LR *schedule as a function of optimizer step* and the
+    per-token learning rate both stay exactly what the original run would
+    have applied, so a recovered run is a deterministic continuation — not
+    a new hyperparameter regime.
+
+    * Primary rule — **constant global batch via accumulation increase**:
+      when ``old_slices`` divides ``new_slices * accum`` cleanly (i.e.
+      ``old/gcd(old,new)`` more microbatches fit), grad-accumulation is
+      multiplied by ``old_slices / gcd`` while the per-device batch stays
+      put, which keeps tokens-per-optimizer-step CONSTANT.  The LR
+      schedule is untouched: same steps, same batch, same per-token LR.
+      (2 slices -> 1 doubles accumulation; 3 -> 2 runs accum x3 against
+      batch x2 — handled by the gcd form below.)
+    * Fallback — **linear LR scaling**: when the accumulation factor would
+      not be integral (it always is with the gcd form, so this arm exists
+      only for ``scale_lr_instead=True``-style callers via
+      :func:`rescale_lr_only`), shrink the global batch proportionally to
+      the surviving slices and scale LR by ``new/old`` (Goyal et al.
+      linear scaling), keeping the per-token LR constant that way.
+
+    The gcd form: global batch B = accum * local * dp, and dp shrinks by
+    ``new/old``.  Keeping B constant needs ``accum *= old/new``; to stay
+    integral for any (old, new) we scale accum by ``old // g`` and accept
+    a global batch of ``B * new * (old // g) / old`` = ``B * (new // g)``
+    ... which equals B exactly when ``g == new`` (new divides old, the
+    overwhelmingly common shrink: N -> N-k with k=N/2, or 2 -> 1).  For
+    non-divisible shrinks the residual batch ratio is folded into the LR
+    instead, so the per-token LR is STILL exactly preserved.
+    """
+    if old_slices < 1 or new_slices < 1 or new_slices >= old_slices:
+        raise ValueError(
+            f"rescale needs 1 <= new_slices < old_slices, got "
+            f"{old_slices} -> {new_slices}")
+    import math
+
+    g = math.gcd(old_slices, new_slices)
+    accum_factor = old_slices // g
+    # tokens/step ratio after the accum increase: new * accum_factor / old
+    batch_ratio = new_slices * accum_factor / old_slices
+    lr_scale = batch_ratio  # == 1.0 whenever new divides old
+    return Rescale(old_slices=old_slices, new_slices=new_slices,
+                   accum_factor=accum_factor, lr_scale=lr_scale)
+
+
+def rescale_lr_only(old_slices: int, new_slices: int) -> Rescale:
+    """The fallback arm as an explicit choice: keep accumulation, shrink
+    the global batch with the surviving slices, scale LR linearly
+    (``new/old``) so the per-token LR stays constant."""
+    if old_slices < 1 or new_slices < 1 or new_slices >= old_slices:
+        raise ValueError(
+            f"rescale needs 1 <= new_slices < old_slices, got "
+            f"{old_slices} -> {new_slices}")
+    return Rescale(old_slices=old_slices, new_slices=new_slices,
+                   accum_factor=1, lr_scale=new_slices / old_slices)
+
+
+# ---------------------------------------------------------------------------
+# Detection
+# ---------------------------------------------------------------------------
+class ElasticCoordinator:
+    """Slice-granular health detector.
+
+    Single-process (CPU dryrun, emulated slices): health is driven entirely
+    by the deterministic fault points — ``elastic_heartbeat`` fires first
+    (a ``:kill`` here IS a host dying between heartbeats), then
+    ``slice_loss`` renders the verdict (``raise`` mode -> the drilled
+    slice is reported lost).
+
+    Multi-process: every poll is a TWO-round KV protocol on the dedicated
+    ``elastic`` namespace.  Round 1 (heartbeats): each host publishes a
+    health key and takes a BOUNDED barrier (``heartbeat_timeout_s`` —
+    satellite ``dist_utils`` timeouts); a host missing the deadline, or
+    one that locally caught a preemption signal and voted itself
+    unhealthy, is mapped through the mesh's ``slice_processes`` table to
+    the slice that owns it.  Round 2 (verdict agreement): each host
+    publishes its round-1 verdict and every survivor adopts the MINIMUM
+    lost slice ANY survivor reported — deadlines are measured from each
+    caller's arrival, so without this round a straggler's key could land
+    after host A's deadline but before host B's and split the pool; with
+    it, one observer is enough for everyone to recover.  Poll is
+    COLLECTIVE: every host must call it on the same steps (the recipe
+    polls on a fixed step cadence); the previous poll's keys are GC'd by
+    process 0 each round.
+    """
+
+    def __init__(self, mesh_manager, *,
+                 heartbeat_timeout_s: float = 60.0,
+                 signal_handler=None,
+                 namespace: Optional[CollectiveNamespace] = None):
+        self.mesh_manager = mesh_manager
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.signal_handler = signal_handler
+        self.namespace = namespace or CollectiveNamespace("elastic")
+        self._poll_seq = 0
+        self.last_poll_t: Optional[float] = None
+        self.prev_poll_t: Optional[float] = None
+        self._last_hb_key: Optional[str] = None
+
+    # -- topology ----------------------------------------------------------
+    @property
+    def num_slices(self) -> int:
+        return self.mesh_manager.dcn_dp_size
+
+    def slice_of_process(self, process_index: int) -> int:
+        for s in range(self.num_slices):
+            if process_index in self.mesh_manager.slice_processes(s):
+                return s
+        raise ValueError(f"process {process_index} on no slice")
+
+    def _drilled_lost_slice(self) -> int:
+        env = os.environ.get(LOST_SLICE_ENV)
+        if env is not None:
+            return int(env)
+        return self.num_slices - 1
+
+    # -- the poll ----------------------------------------------------------
+    def poll(self, step: int = -1) -> None:
+        """Collective health check; raises :class:`SliceLostError` when a
+        slice is gone, returns None when the pool is healthy."""
+        self._poll_seq += 1
+        self.prev_poll_t, self.last_poll_t = (self.last_poll_t,
+                                              time.monotonic())
+        # A ``:kill`` armed here is this host dying between heartbeats —
+        # no unwinding, exactly like a preemption SIGKILL (the drill for
+        # "host vanishes mid-async-commit" arms the hit count so the
+        # background committer is still writing when the process exits).
+        fault_point("elastic_heartbeat")
+        # Verdict fault point: raise-mode drills model the SURVIVORS'
+        # view — a peer slice stopped answering.
+        try:
+            fault_point("slice_loss")
+        except InjectedFault as e:
+            raise SliceLostError(
+                self._drilled_lost_slice(),
+                f"injected slice loss ({e})", step) from e
+        if jax.process_count() <= 1:
+            return
+        self._poll_multihost(step)
+
+    def _poll_multihost(self, step: int) -> None:
+        # Local health: a caught preemption signal means this host's slice
+        # is about to die — vote it out while we still can.
+        healthy = not (self.signal_handler is not None
+                       and self.signal_handler.received)
+        my_slice = self.slice_of_process(jax.process_index())
+        client = self.namespace._client()
+        if client is None:
+            # No coordination service (never the case after
+            # jax.distributed.initialize): heartbeats are impossible, and a
+            # device-collective stand-in would hang exactly when a slice
+            # died — the thing this detector exists to avoid.
+            logger.warning(
+                "ElasticCoordinator: no jax.distributed coordination "
+                "client; slice-health heartbeats disabled")
+            return
+        key = f"{self.namespace.name}/hb/{self._poll_seq}"
+        client.key_value_set(f"{key}/p{jax.process_index()}",
+                             "1" if healthy else "0")
+        from automodel_tpu.utils.dist_utils import _is_timeout_error
+
+        timeout_ms = int(self.heartbeat_timeout_s * 1000)
+        timed_out = False
+        try:
+            client.wait_at_barrier(key + ".in", timeout_ms)
+        except Exception as e:
+            # ONLY a deadline expiry means "a peer missed its heartbeat" —
+            # fall through and read the keys that DID land (every survivor
+            # wrote its own before blocking here, so all survivors see the
+            # same vote set).  Any other coordination-service failure
+            # (connection loss, tag reuse, protocol bug) must propagate:
+            # folding it into the verdict would shrink away a healthy
+            # slice over a transient RPC error.
+            if not _is_timeout_error(e):
+                raise
+            timed_out = True
+        votes = {}
+        for k, v in client.key_value_dir_get(f"{key}/"):
+            try:
+                votes[int(k.rsplit("p", 1)[1])] = v
+            except (ValueError, IndexError):  # pragma: no cover
+                continue
+        my_lost: set = set()
+        reasons: dict = {}
+        for s in range(self.num_slices):
+            procs = self.mesh_manager.slice_processes(s)
+            missing = [p for p in procs if p not in votes]
+            sick = [p for p in procs if votes.get(p) == "0"]
+            if missing or sick:
+                my_lost.add(s)
+                reasons[s] = (
+                    f"host(s) {missing} missed the heartbeat deadline"
+                    if missing else
+                    f"host(s) {sick} voted unhealthy (preempted)")
+        # VERDICT AGREEMENT round: each host's dir read above is its OWN
+        # observation — a straggler whose key landed after host A's
+        # deadline but before host B's would otherwise split the pool
+        # (A shrinks, B keeps training).  Each host publishes its full
+        # lost-set and every survivor adopts the UNION: one observer is
+        # enough for everyone to recover, and a healthy-but-slow straggler
+        # is dragged along at the next poll (it reads these keys too).
+        client.key_value_set(f"{key}.verdict/p{jax.process_index()}",
+                             ",".join(str(s) for s in sorted(my_lost)))
+        try:
+            client.wait_at_barrier(key + ".verdict_in", timeout_ms)
+        except Exception as e:
+            if not _is_timeout_error(e):
+                raise
+            # deadline only: the dead host is absent here too; read what
+            # landed
+        agreed: set = set(my_lost)
+        for k, v in client.key_value_dir_get(f"{key}.verdict/"):
+            agreed.update(int(s) for s in v.split(",") if s.strip())
+        lost: Optional[int] = None
+        reason = ""
+        if len(agreed) >= self.num_slices:
+            # EVERY slice reports losses: that is not a slice failure, it
+            # is a full-pool preemption/teardown — shrinking is impossible
+            # and wrong.  Return healthy and let the recipe's preemption
+            # poll (which runs before the next elastic poll) take the
+            # grace-window save; the kill that follows is the relaunch
+            # path's business.
+            logger.warning(
+                "elastic heartbeat %s: every slice reports unhealthy "
+                "hosts — treating as full-pool preemption, deferring to "
+                "the grace-window save path", key)
+        elif agreed:
+            lost = min(agreed)  # deterministic on every survivor
+            reason = reasons.get(
+                lost, "a peer survivor reported the loss (verdict round)")
+        elif timed_out:
+            # deadline expired yet every vote AND every verdict says
+            # healthy (a straggler that recovered): keep training
+            logger.warning(
+                "elastic heartbeat %s: deadline expired but all votes "
+                "present and no survivor reported a loss; continuing", key)
+        # GC the PREVIOUS poll's keys (votes + verdicts): every survivor
+        # has consumed them by now; without this a long run grows the
+        # coordination service's store by num_hosts keys per poll forever.
+        # Owner = the lowest process THAT VOTED this round (not literal 0:
+        # after slice 0 dies and the pool recovers in place, process 0 no
+        # longer exists and a pinned owner would leak forever).
+        prev, self._last_hb_key = self._last_hb_key, key
+        gc_owner = min(votes) if votes else 0
+        if prev is not None and jax.process_index() == gc_owner:
+            for d in (f"{prev}/", f"{prev}.verdict/"):
+                try:
+                    client.key_value_delete(d)
+                except Exception:  # pragma: no cover - best-effort GC
+                    pass
+        if lost is not None:
+            raise SliceLostError(lost, reason, step,
+                                 local=(lost == my_slice))
+
+    def detect_latency_s(self) -> float:
+        """Upper bound on how long the just-detected failure went unseen:
+        the gap back to the PREVIOUS poll (the failure happened somewhere
+        inside it).  Charged to the ``elastic_detect`` goodput timer."""
+        if self.prev_poll_t is None or self.last_poll_t is None:
+            return 0.0
+        return max(0.0, self.last_poll_t - self.prev_poll_t)

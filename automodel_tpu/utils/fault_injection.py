@@ -63,6 +63,22 @@ Kernel-substrate points (see ``ops/kernel_lib/autotune.py``):
                       defaults, NEVER fail recipe setup (the fault is
                       swallowed by the load path's degradation handler,
                       not surfaced).
+
+Elastic multi-slice points (see ``utils/elastic.py``):
+
+    elastic_heartbeat in ``ElasticCoordinator.poll``, before this host
+                      publishes its heartbeat — ``:kill`` here is a host
+                      dying BETWEEN heartbeats (the canonical preemption),
+                      including mid-async-commit when armed to fire while
+                      a background checkpoint is still writing: recovery
+                      must resume from the PREVIOUS committed step.
+    slice_loss        in ``ElasticCoordinator.poll``, at the slice-health
+                      verdict — ``raise`` mode is converted by the
+                      coordinator into a SliceLostError for the drilled
+                      slice (in-process recovery: shrink + rescale +
+                      restore); ``:kill`` hard-exits, modelling the hosts
+                      of the lost slice vanishing (recovery = relaunch at
+                      dcn_dp-1 resuming from the last committed step).
 """
 
 from __future__ import annotations
@@ -92,6 +108,8 @@ KNOWN_FAULT_POINTS = frozenset({
     "ckpt_post_commit",
     "input_producer",
     "kernel_autotune_cache",
+    "elastic_heartbeat",
+    "slice_loss",
 })
 
 

@@ -5,44 +5,93 @@ Reference parity: ``nemo_automodel/components/utils/sig_utils.py:51-168``
 rank learns of a preemption even when only one host received the signal).
 The all-gather is ``multihost_utils.process_allgather`` — every process must
 call :meth:`signals_received` collectively (e.g. once per checkpoint window).
+
+Hardened for the elastic stack: a handler may trap a LIST of signals
+(SIGTERM + SIGINT — GKE preemption and operator ^C look identical to the
+grace-window save), previous handlers are ALWAYS restored on ``__exit__``
+(``signal.getsignal`` returns ``None`` for handlers installed from C — the
+best restoration Python can do there is ``SIG_DFL``, never leaking our
+handler), and a callable previous handler is chained so wrapping an outer
+framework's handler does not silence it.
 """
 
 from __future__ import annotations
 
 import signal
-from typing import Optional
+from typing import Dict, Iterable, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 
 class DistributedSignalHandler:
-    def __init__(self, sig: int = signal.SIGTERM):
-        self.sig = sig
+    def __init__(self,
+                 sig: Union[int, Sequence[int]] = signal.SIGTERM,
+                 chain: bool = True):
+        sigs: Tuple[int, ...] = tuple(sig) if isinstance(
+            sig, Iterable) else (sig,)
+        if not sigs:
+            raise ValueError("DistributedSignalHandler needs >= 1 signal")
+        self.sigs = sigs
+        self.sig = sigs[0]  # primary signal (back-compat accessor)
+        self.chain = chain
         self._received = False
-        self._prev_handler = None
+        self._received_sig: Optional[int] = None
+        self._prev_handlers: Dict[int, object] = {}
 
     # -- context -----------------------------------------------------------
     def __enter__(self):
         self._received = False
-        self._prev_handler = signal.getsignal(self.sig)
-        signal.signal(self.sig, self._handler)
+        self._received_sig = None
+        self._prev_handlers = {}
+        self._hits: Dict[int, int] = {}
+        for s in self.sigs:
+            self._prev_handlers[s] = signal.getsignal(s)
+            signal.signal(s, self._handler)
         return self
 
     def __exit__(self, *exc):
-        if self._prev_handler is not None:
-            signal.signal(self.sig, self._prev_handler)
+        for s, prev in self._prev_handlers.items():
+            # getsignal() -> None means the previous handler was installed
+            # from C and cannot be re-installed from Python; restoring
+            # SIG_DFL is the closest we can get — leaving OUR handler bound
+            # past the context (the old behavior) is strictly worse: it
+            # keeps flipping a dead object's flag forever.
+            signal.signal(s, prev if prev is not None else signal.SIG_DFL)
+        self._prev_handlers = {}
         return False
 
     def _handler(self, signum, frame):
         self._received = True
+        self._received_sig = signum
+        self._hits[signum] = self._hits.get(signum, 0) + 1
+        prev = self._prev_handlers.get(signum)
+        if not (self.chain and callable(prev)
+                and prev not in (signal.SIG_IGN, signal.SIG_DFL)):
+            return
+        if prev is signal.default_int_handler:
+            # The stdlib ^C handler raises KeyboardInterrupt, which would
+            # unwind training before the collective signals_received poll
+            # can run the grace-window save (the whole point of trapping
+            # SIGINT alongside SIGTERM) — so the FIRST ^C only sets the
+            # flag.  A SECOND ^C is the operator insisting: chain it
+            # (KeyboardInterrupt) so a hung run stays abortable.
+            if self._hits[signum] > 1:
+                prev(signum, frame)
+            return
+        prev(signum, frame)
 
     # -- queries -----------------------------------------------------------
     @property
     def received(self) -> bool:
         return self._received
 
+    @property
+    def received_signal(self) -> Optional[int]:
+        """The signal number that fired locally (None before any)."""
+        return self._received_sig
+
     def signals_received(self) -> bool:
-        """True if ANY process received the signal.  Collective call."""
+        """True if ANY process received a trapped signal.  Collective call."""
         import jax
 
         if jax.process_count() == 1:

@@ -148,6 +148,14 @@ SECONDARY = {
     # GShard one-hot dispatch).  ``BENCH_MOE_DISPATCH=sorted|onehot`` pins
     # one path (no ratio).
     "moe": [],
+    # Elastic recovery leg: handled by _elastic_secondary_main — the
+    # slice-loss drill on the 8-virtual-device dcn_dp=2 mesh (same harness
+    # as the dryrun elastic leg and the tier-1 fault drills).  Reports
+    # ``recovery_time_s`` (detect + rebuild + replay seconds for one
+    # slice loss) and ``goodput_fraction`` (productive fraction of the
+    # drill window) as extra secondary keys.  ``BENCH_ELASTIC=0`` skips
+    # the leg (records null).
+    "elastic": [],
     # Checkpoint-stall leg: handled by _ckpt_secondary_main — times a
     # training window containing saves under checkpoint.async_save true vs
     # false through the real recipe save path.  Reports the mean per-save
@@ -390,6 +398,49 @@ def _moe_secondary_main() -> None:
                       "vs_baseline": round(srt / onehot, 4)}))
 
 
+def _elastic_secondary_main() -> None:
+    """Child process: the elastic slice-loss recovery leg.
+
+    Runs the deterministic drill (``analysis/elastic_drill.py``) on the
+    8-virtual-device dcn_dp=2 mesh: train, async-checkpoint, lose a slice,
+    shrink to dcn_dp=1, rescale by the documented rule, resume from the
+    last committed step, finish.  Absolute seconds on virtual CPU devices
+    are not chip-meaningful — the leg exists so ``recovery_time_s`` stays
+    BOUNDED (a hang or an operator-action regression shows up as a null/
+    timeout here) and ``goodput_fraction`` is tracked run over run.
+    ``BENCH_ELASTIC=0`` skips the leg.
+    """
+    if os.environ.get("BENCH_ELASTIC", "1") == "0":
+        raise SystemExit("BENCH_ELASTIC=0: elastic leg skipped")
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from automodel_tpu.analysis.elastic_drill import run_elastic_drill
+    from automodel_tpu.utils import fault_injection as fi
+
+    fi.configure_faults("slice_loss:4")
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            report = run_elastic_drill(d, total_steps=6, save_step=2,
+                                       fault_step=4)
+    finally:
+        fi.reset_faults()
+    dev = report["max_dev_vs_uninterrupted"]
+    assert dev is not None and dev < 1e-3, (
+        f"post-recovery trajectory diverged by {dev}")
+    print(json.dumps({
+        "tps": round(report["recovery_time_s"], 3),
+        "recovery_time_s": round(report["recovery_time_s"], 3),
+        "goodput_fraction": round(report["goodput_fraction"], 4),
+    }))
+
+
 def _ckpt_secondary_main() -> None:
     """Child process: the checkpoint-stall leg.
 
@@ -475,6 +526,8 @@ def _secondary_main(name: str) -> None:
         return _moe_secondary_main()
     if name == "ckpt_stall_ms":
         return _ckpt_secondary_main()
+    if name == "elastic":
+        return _elastic_secondary_main()
     steps, warmup = (4, 2) if SMALL else (8, 3)
     if name == "unpacked" and not SMALL:
         # two length buckets (1024/1152) after the 128-alignment: warm both
@@ -552,6 +605,11 @@ def _collect_secondary() -> dict:
             out[name] = parsed["tps"]
             if "vs_baseline" in parsed:
                 out[f"{name}_vs_baseline"] = parsed["vs_baseline"]
+            # extra leg-specific metrics ride through verbatim (the
+            # elastic leg reports goodput_fraction + recovery_time_s)
+            for k, v in parsed.items():
+                if k not in ("tps", "vs_baseline"):
+                    out[k] = v
         except Exception:
             out[name] = None
     return out

@@ -299,3 +299,26 @@ def test_moe_ep_leg_emits_expert_layout_constraints():
     the regression the old stringified-jaxpr pin guarded)."""
     _, census = _leg_and_census("moe_ep")
     assert census.sharding_constraints >= 4
+
+
+def test_dcn_leg_confines_dense_collectives_to_ici():
+    """The hierarchical-DP pin behind the ``dcn2_dp2xtp2`` golden (ISSUE 9):
+    gradient sync across slices is a (small) all-reduce keyed to ``dcn_dp``
+    alone, while the dense FSDP all-gathers and any all-to-all stay on the
+    inner ICI axes — DCN only ever carries the hierarchical reduce."""
+    _, census = _leg_and_census("dcn2_dp2xtp2")
+    hlo = census.hlo_collectives
+    # the cross-slice gradient all-reduce exists, keyed to dcn_dp only
+    assert hlo["all-reduce"].get("dcn_dp", 0) > 0
+    # the largest all-gather whose groups touch dcn_dp must not exceed the
+    # largest ICI gather: dense parameter traffic never crosses DCN
+    ag = census.hlo_allgather_max_bytes
+    ici_max = max(v for k, v in ag.items() if "dcn_dp" not in k.split(","))
+    for key, nbytes in ag.items():
+        if "dcn_dp" in key.split(","):
+            assert nbytes <= ici_max, (
+                f"all-gather over {key} ({nbytes}B) exceeds the largest "
+                f"ICI gather ({ici_max}B): a dense collective crossed DCN")
+    # expert/token shuffles (all-to-all) must never cross slices
+    for key in hlo.get("all-to-all", {}):
+        assert "dcn_dp" not in key.split(",")

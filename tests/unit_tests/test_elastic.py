@@ -1,0 +1,453 @@
+"""Elastic multi-slice training (ISSUE 9 tentpole): hierarchical DP over
+``dcn_dp`` + slice-loss detection + deterministic recovery.
+
+Tier-1 surface:
+
+* the documented rescale rule is PINNED (constant per-token LR via
+  accumulation increase; residual ratios fold into a linear LR scale);
+* ``MeshManager`` grows a first-class ``dcn_dp`` outer axis with emulated
+  slices on CPU, ``shrink_slices`` builds the survivors' mesh, and unknown
+  kwargs warn (or raise under strict config) instead of vanishing;
+* the ``slice_loss`` / ``elastic_heartbeat`` fault points drill both
+  failure shapes: ``raise`` (survivors detect a dead peer slice and
+  recover IN PROCESS: shrink -> rescale -> restore-from-last-committed,
+  post-recovery trajectory matching an uninterrupted shrunk-mesh run) and
+  ``:kill`` (this host dies — including MID-ASYNC-COMMIT, where the
+  relaunch must fall back to the PREVIOUS committed step);
+* the new ``dcn2_dp2xtp2`` golden census leg keeps cross-slice gradient
+  collectives on ``dcn_dp`` only, with dense FSDP/TP collectives confined
+  to the inner ICI axes;
+* bounded collective waits: ``CollectiveTimeout`` carries the tag.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from automodel_tpu.utils import fault_injection as fi
+from automodel_tpu.utils.elastic import (
+    ElasticCoordinator,
+    SliceLostError,
+    build_elastic_config,
+    rescale_for_slice_loss,
+    rescale_lr_only,
+)
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.reset_faults()
+    yield
+    fi.reset_faults()
+
+
+# ---------------------------------------------------------------------------
+# The rescale rule (pinned)
+# ---------------------------------------------------------------------------
+def test_rescale_rule_constant_per_token_lr():
+    # the canonical shrink: new divides old -> pure accumulation increase,
+    # LR schedule untouched (tokens/step constant)
+    r = rescale_for_slice_loss(2, 1)
+    assert (r.accum_factor, r.lr_scale) == (2, 1.0)
+    r = rescale_for_slice_loss(4, 2)
+    assert (r.accum_factor, r.lr_scale) == (2, 1.0)
+    r = rescale_for_slice_loss(4, 1)
+    assert (r.accum_factor, r.lr_scale) == (4, 1.0)
+    # non-divisible shrink: accum takes the gcd-integral factor and the
+    # residual tokens/step ratio folds into a LINEAR LR scale, so the
+    # per-token LR is still exactly preserved
+    r = rescale_for_slice_loss(3, 2)
+    assert r.accum_factor == 3
+    assert r.lr_scale == pytest.approx(2.0)  # tokens/step x2 -> lr x2
+    # per-token LR invariant: lr_scale / (tokens ratio) == 1
+    tokens_ratio = r.new_slices * r.accum_factor / r.old_slices
+    assert r.lr_scale / tokens_ratio == pytest.approx(1.0)
+
+
+def test_rescale_lr_only_arm_and_validation():
+    r = rescale_lr_only(4, 3)
+    assert r.accum_factor == 1 and r.lr_scale == pytest.approx(0.75)
+    for bad in ((1, 1), (2, 2), (2, 3), (0, 1)):
+        with pytest.raises(ValueError):
+            rescale_for_slice_loss(*bad)
+        with pytest.raises(ValueError):
+            rescale_lr_only(*bad)
+
+
+def test_elastic_config_build():
+    cfg = build_elastic_config(None)
+    assert not cfg.enabled
+    cfg = build_elastic_config({"heartbeat_interval_steps": 5})
+    assert cfg.enabled and cfg.heartbeat_interval_steps == 5
+    with pytest.raises(ValueError, match="unknown elastic"):
+        build_elastic_config({"heartbeat_intervall": 5})
+
+
+# ---------------------------------------------------------------------------
+# Mesh: the dcn_dp axis, emulated slices, strict unknown-kwarg handling
+# ---------------------------------------------------------------------------
+def test_mesh_dcn_dp_axis_and_emulated_slices():
+    from automodel_tpu.distributed.mesh import MeshManager
+
+    mm = MeshManager(dcn_dp_size=2, dp_size=4, tp_size=2)
+    assert mm.dcn_dp_size == 2 and mm.dp_size == 4
+    assert dict(mm.mesh.shape)["dcn_dp"] == 2
+    # emulated slices partition the device list contiguously
+    ids0 = [d.id for d in mm.slice_devices(0)]
+    ids1 = [d.id for d in mm.slice_devices(1)]
+    assert len(ids0) == len(ids1) == 4 and not set(ids0) & set(ids1)
+    # dcn_dp=1 meshes are unchanged in extent accounting
+    flat = MeshManager(dp_size=4, tp_size=2)
+    assert flat.dcn_dp_size == 1 and flat.dp_size == 4
+
+
+def test_mesh_shrink_slices_builds_survivor_mesh():
+    from automodel_tpu.distributed.mesh import MeshManager
+
+    mm = MeshManager(dcn_dp_size=2, dp_size=4, tp_size=2)
+    survivors = mm.shrink_slices(1)
+    assert survivors.dcn_dp_size == 1 and survivors.world_size == 4
+    assert [d.id for d in survivors.mesh.devices.flatten()] == [
+        d.id for d in mm.slice_devices(0)]
+    with pytest.raises(ValueError, match="out of range"):
+        mm.shrink_slices(5)
+    with pytest.raises(ValueError, match="single-slice"):
+        survivors.shrink_slices(0)
+
+
+def test_mesh_unknown_kwargs_warn_and_strict_raises(caplog):
+    import logging
+
+    from automodel_tpu.distributed.mesh import MeshManager
+
+    with caplog.at_level(logging.WARNING, "automodel_tpu.distributed.mesh"):
+        MeshManager(dp_size=8, dcn_dp_sizee=2)  # the misspelling drill
+    assert any("dcn_dp_sizee" in r.message and "dcn_dp_size" in r.message
+               for r in caplog.records)
+    with pytest.raises(TypeError, match="dcn_dp_sizee"):
+        MeshManager(dp_size=8, dcn_dp_sizee=2, strict=True)
+    # env-driven strict config (the YAML-run spelling of strict=True)
+    os.environ["AUTOMODEL_STRICT_CONFIG"] = "1"
+    try:
+        with pytest.raises(TypeError):
+            MeshManager(dp_size=8, not_a_knob=1)
+    finally:
+        del os.environ["AUTOMODEL_STRICT_CONFIG"]
+
+
+# ---------------------------------------------------------------------------
+# Bounded collective waits
+# ---------------------------------------------------------------------------
+def test_collective_timeout_names_tag_and_single_process_passthrough():
+    from automodel_tpu.utils.dist_utils import (
+        CollectiveNamespace,
+        CollectiveTimeout,
+        all_hosts_ok,
+        barrier,
+    )
+
+    e = CollectiveTimeout("elastic/hb/3.in", 5.0, "deadline exceeded")
+    assert e.tag == "elastic/hb/3.in" and "elastic/hb/3.in" in str(e)
+    assert isinstance(e, TimeoutError)
+    # single-process: bounded calls are no-ops / local verdicts
+    barrier("t", timeout=0.001)
+    assert all_hosts_ok(True, "t", timeout=0.001)
+    assert not all_hosts_ok(False, "t", timeout=0.001)
+    ns = CollectiveNamespace("test_ns")
+    ns.barrier("t", timeout=0.001)
+    assert ns.all_hosts_ok(True, "t", timeout=0.001)
+
+
+# ---------------------------------------------------------------------------
+# Detection: the coordinator + the slice_loss / elastic_heartbeat drills
+# ---------------------------------------------------------------------------
+def _coordinator(dcn_dp=2):
+    from automodel_tpu.distributed.mesh import MeshManager
+
+    mm = MeshManager(dcn_dp_size=dcn_dp, dp_size=4, tp_size=2)
+    return ElasticCoordinator(mm, heartbeat_timeout_s=1.0)
+
+
+def test_slice_loss_raise_drill_yields_typed_event():
+    coord = _coordinator()
+    fi.configure_faults("slice_loss:2")
+    coord.poll(1)  # healthy
+    with pytest.raises(SliceLostError) as ei:
+        coord.poll(2)
+    assert ei.value.slice_id == 1  # default: the last slice dies
+    assert ei.value.detected_at_step == 2
+    assert isinstance(ei.value.__cause__, fi.InjectedFault)
+
+
+def test_slice_loss_env_picks_the_lost_slice(monkeypatch):
+    coord = _coordinator()
+    monkeypatch.setenv("AUTOMODEL_LOST_SLICE", "0")
+    fi.configure_faults("slice_loss:1")
+    with pytest.raises(SliceLostError) as ei:
+        coord.poll(7)
+    assert ei.value.slice_id == 0
+
+
+def test_elastic_heartbeat_raise_drill_propagates():
+    """Raise-mode ``elastic_heartbeat``: this host failed its own heartbeat
+    publish — a local error, surfaced as-is (not a slice verdict)."""
+    coord = _coordinator()
+    fi.configure_faults("elastic_heartbeat:1")
+    with pytest.raises(fi.InjectedFault):
+        coord.poll(1)
+
+
+def test_detect_latency_tracks_poll_gap():
+    coord = _coordinator()
+    assert coord.detect_latency_s() == 0.0
+    coord.poll(1)
+    coord.poll(2)
+    assert coord.detect_latency_s() >= 0.0
+    assert coord.prev_poll_t is not None
+
+
+# ---------------------------------------------------------------------------
+# Recovery: the full raise-mode drill (shrink -> rescale -> restore ->
+# parity with an uninterrupted shrunk-mesh run)
+# ---------------------------------------------------------------------------
+@pytest.mark.core
+def test_slice_loss_recovery_matches_uninterrupted_run(tmp_path):
+    from automodel_tpu.analysis.elastic_drill import run_elastic_drill
+
+    fi.configure_faults("slice_loss:3")
+    report = run_elastic_drill(str(tmp_path), total_steps=4, save_step=1,
+                               fault_step=3)
+    rec = report["recovery"]
+    assert rec["new_dcn_dp"] == 1
+    assert rec["accum_factor"] == 2 and rec["lr_scale"] == 1.0
+    assert rec["restored_step"] == 1
+    assert os.path.basename(rec["restored_from"]) == "epoch_0_step_1"
+    dev = report["max_dev_vs_uninterrupted"]
+    assert dev is not None and dev < 1e-3, (
+        f"post-recovery trajectory diverged by {dev}")
+    # goodput accounting: a recovery costs time, and all of it is counted
+    assert report["recovery_time_s"] > 0.0
+    assert 0.0 <= report["goodput_fraction"] < 1.0
+
+
+def test_stacked_recoveries_rescale_from_checkpoint_regime(tmp_path):
+    """Two slice losses with NO new checkpoint between them must not
+    compound: the rescale is computed from the regime the RESTORED
+    checkpoint was saved under (ElasticState), so accumulation and the
+    rewound LR fields stay one consistent regime (per-token LR exact)."""
+    from automodel_tpu.analysis.elastic_drill import (
+        BASE_GRAD_ACC,
+        _build_recipe,
+        train_one_step,
+    )
+
+    rec = _build_recipe(str(tmp_path), dcn_dp=4)  # 4 x shard1 x tp2 = 8
+    train_one_step(rec, 1)
+    rec.save_checkpoint(0, 1)
+    rec.join_pending_save()
+    # loss 1: 4 -> 3 (non-divisible: accum x4, lr x3 vs the checkpoint)
+    info1 = rec.recover_from_slice_loss(SliceLostError(3, "drill", 2))
+    assert info1["accum_factor"] == 4
+    assert rec.step_scheduler.grad_acc_steps == BASE_GRAD_ACC * 4
+    # loss 2 BEFORE any new checkpoint: restore rewinds to the dcn=4
+    # checkpoint regime, so the rescale must be 4 -> 2 (x2, lr x1) — NOT
+    # 3 -> 2 stacked on the already-x4 accumulation
+    info2 = rec.recover_from_slice_loss(SliceLostError(2, "drill", 3))
+    assert info2["accum_factor"] == 2 and info2["lr_scale"] == 1.0
+    assert rec.step_scheduler.grad_acc_steps == BASE_GRAD_ACC * 2
+    assert rec.mesh_manager.dcn_dp_size == 2
+    rec.teardown()
+
+
+def test_recover_requires_committed_checkpoint(tmp_path):
+    from automodel_tpu.analysis.elastic_drill import (
+        _build_recipe,
+        train_one_step,
+    )
+    from automodel_tpu.checkpoint.checkpointing import CheckpointSaveError
+
+    rec = _build_recipe(str(tmp_path / "none"), dcn_dp=2)
+    train_one_step(rec, 1)
+    with pytest.raises(CheckpointSaveError, match="no committed checkpoint"):
+        rec.recover_from_slice_loss(SliceLostError(1, "drill", 1))
+
+
+def test_recover_on_single_slice_raises_designed_error(tmp_path):
+    """A slice loss at dcn_dp=1 is a full-pool loss: recovery must surface
+    the designed relaunch-shaped error, not a rescale-domain ValueError."""
+    from automodel_tpu.analysis.elastic_drill import _build_recipe
+
+    rec = _build_recipe(str(tmp_path), dcn_dp=1)
+    with pytest.raises(ValueError, match="single-slice"):
+        rec.recover_from_slice_loss(SliceLostError(0, "drill", 1))
+
+
+def test_recipe_elastic_recovery_end_to_end(tmp_path):
+    """The full recipe loop (train_ft) on a dcn_dp=2 mesh: a slice_loss
+    drill mid-run must be detected by the per-step health poll, recovered
+    in place (mesh shrunk, input pipeline rebuilt at the new dp width,
+    state restored from the last committed checkpoint), and the run must
+    FINISH its step budget on the shrunk mesh with no operator action."""
+    from automodel_tpu.config.arg_parser import parse_args_and_load_config
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
+    )
+
+    yaml = os.path.join(os.path.dirname(__file__), "..", "..",
+                        "examples", "llm_finetune", "tiny_llama_mock.yaml")
+    cfg = parse_args_and_load_config([
+        "--config", yaml,
+        "--checkpoint.checkpoint_dir", str(tmp_path),
+        "--checkpoint.model_save_format", "orbax",
+        "--checkpoint.save_consolidated", "false",
+        "--distributed.dcn_dp_size", "2",
+        "--elastic.heartbeat_interval_steps", "1",
+        "--step_scheduler.ckpt_every_steps", "2",
+        "--step_scheduler.max_steps", "6",
+        "--step_scheduler.val_every_steps", "null",
+    ])
+    recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+    assert recipe.mesh_manager.dcn_dp_size == 2
+    fi.configure_faults("slice_loss:4")  # 4th per-step poll = step 4
+    recipe.run_train_validation_loop()
+    assert recipe.step_scheduler.step == 6, "run must finish its budget"
+    assert recipe.mesh_manager.dcn_dp_size == 1, "mesh must have shrunk"
+    assert np.isfinite(recipe.last_metrics["loss"])
+    # the rebuilt input pipeline serves the shrunk dp width
+    assert recipe.step_fns.microbatch_sharding.mesh.devices.size == 4
+    # goodput accounting closed cleanly (any replay window was stopped)
+    assert getattr(recipe, "_replay_until", None) is None
+    recipe.timers.get_elapsed(reset=False)  # no dangling timer state
+
+
+# ---------------------------------------------------------------------------
+# Kill-mode drills: the process IS the dying slice
+# ---------------------------------------------------------------------------
+def _run_kill_child(tmp_path, subprocess_env, fault_spec, body):
+    env = subprocess_env(8)
+    env[fi.FAULT_ENV] = fault_spec
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from automodel_tpu.analysis import elastic_drill as ed\n"
+        + body)
+    return subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=540,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.dirname(os.path.abspath(__file__)))))
+
+
+def test_slice_loss_kill_drill_hard_exits_after_commit(
+        tmp_path, subprocess_env):
+    """``slice_loss:2:kill``: the host dies at the step-2 poll — after the
+    step-2 save dispatched.  The exit is the preemption sentinel and the
+    committed checkpoint survives for the relaunch."""
+    proc = _run_kill_child(
+        tmp_path, subprocess_env, "slice_loss:2:kill",
+        f"ed.drill_phase1_kill({str(tmp_path)!r}, saves=(2,), "
+        "total_steps=4)\n")
+    assert proc.returncode == fi._KILL_EXIT_CODE, proc.stderr[-2000:]
+    from automodel_tpu.checkpoint.checkpointing import (
+        find_latest_checkpoint,
+        is_committed,
+        verify_manifest,
+    )
+
+    latest = find_latest_checkpoint(str(tmp_path / "elastic_ckpt"))
+    assert latest is not None and is_committed(latest)
+    assert verify_manifest(latest)["step"] == 2
+
+
+def test_elastic_heartbeat_kill_mid_async_commit_resumes_previous_step(
+        tmp_path, subprocess_env):
+    """THE kill-mid-async-commit drill: save at step 2 commits; the save
+    dispatched at step 4 is still writing in the background committer when
+    the ``elastic_heartbeat:4:kill`` lands (its host-state pickle is gated
+    slow).  The relaunch at dcn_dp=1 must resume from step 2 — the
+    PREVIOUS committed step — with only a ``.tmp`` left from step 4."""
+    proc = _run_kill_child(
+        tmp_path, subprocess_env, "elastic_heartbeat:4:kill",
+        f"ed.drill_phase1_kill({str(tmp_path)!r}, saves=(2, 4), "
+        "total_steps=8, slow_second_commit=True)\n")
+    assert proc.returncode == fi._KILL_EXIT_CODE, proc.stderr[-2000:]
+    ckpt_dir = tmp_path / "elastic_ckpt"
+    dirs = sorted(os.listdir(ckpt_dir))
+    assert "epoch_0_step_2" in dirs
+    assert "epoch_0_step_4" not in dirs, "torn commit must not look final"
+    assert "epoch_0_step_4.tmp" in dirs
+
+    # phase 2: the survivors' relaunch — resume WITHOUT operator action
+    from automodel_tpu.analysis.elastic_drill import drill_phase2_resume
+
+    out = drill_phase2_resume(str(tmp_path), expect_step=2, extra_steps=2)
+    assert out["restored_step"] == 2
+    assert all(np.isfinite(v[0]) for v in out["metrics"].values())
+
+
+# ---------------------------------------------------------------------------
+# Signal-handler satellite: lists, restoration, chaining
+# ---------------------------------------------------------------------------
+def test_signal_handler_list_restore_and_chain():
+    from automodel_tpu.utils.sig_utils import DistributedSignalHandler
+
+    seen = []
+
+    def outer(signum, frame):
+        seen.append(signum)
+
+    prev = signal.signal(signal.SIGUSR1, outer)
+    try:
+        with DistributedSignalHandler((signal.SIGUSR1,
+                                       signal.SIGUSR2)) as h:
+            signal.raise_signal(signal.SIGUSR2)
+            assert h.received and h.received_signal == signal.SIGUSR2
+            signal.raise_signal(signal.SIGUSR1)
+            # a callable previous handler is CHAINED, not silenced
+            assert seen == [signal.SIGUSR1]
+        # both previous handlers restored on exit
+        assert signal.getsignal(signal.SIGUSR1) is outer
+        assert signal.getsignal(signal.SIGUSR2) in (
+            signal.SIG_DFL, signal.Handlers.SIG_DFL)
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+
+
+def test_sigint_first_press_defers_second_press_aborts():
+    """^C semantics with the grace-save trap: the FIRST SIGINT only sets
+    the flag (the stdlib default_int_handler is NOT chained — it would
+    raise KeyboardInterrupt before the grace-window save could run); a
+    SECOND SIGINT chains it, so a hung run stays abortable."""
+    from automodel_tpu.utils.sig_utils import DistributedSignalHandler
+
+    prev = signal.signal(signal.SIGINT, signal.default_int_handler)
+    try:
+        with DistributedSignalHandler((signal.SIGTERM,
+                                       signal.SIGINT)) as h:
+            signal.raise_signal(signal.SIGINT)  # first ^C: flag only
+            assert h.received and h.received_signal == signal.SIGINT
+            with pytest.raises(KeyboardInterrupt):
+                signal.raise_signal(signal.SIGINT)  # second ^C: abort
+    finally:
+        signal.signal(signal.SIGINT, prev)
+
+
+def test_signal_handler_never_leaks_on_none_prev():
+    """``getsignal`` -> None (C-installed handler) must still be restored
+    (to SIG_DFL) — the old code left OUR handler installed forever."""
+    from automodel_tpu.utils import sig_utils
+
+    h = sig_utils.DistributedSignalHandler(signal.SIGUSR1)
+    orig = signal.getsignal(signal.SIGUSR1)
+    try:
+        h.__enter__()
+        h._prev_handlers[signal.SIGUSR1] = None  # simulate C-installed
+        h.__exit__(None, None, None)
+        assert signal.getsignal(signal.SIGUSR1) in (
+            signal.SIG_DFL, signal.Handlers.SIG_DFL)
+    finally:
+        signal.signal(signal.SIGUSR1, orig)

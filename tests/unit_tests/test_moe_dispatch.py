@@ -407,10 +407,11 @@ def test_sorted_path_layout_audit_under_expert_parallel_mesh():
         spec_for,
     )
 
-    # the EP rule set the audit rides on: tokens over dp/cp, experts over tp
+    # the EP rule set the audit rides on: tokens over dp/cp (incl. the
+    # cross-slice dcn_dp axis, ISSUE 9), experts over tp
     rules = default_rules(expert_parallel=True)
     assert spec_for(("act_tokens", None), rules)[0] == (
-        "dp_replicate", "dp_shard", "cp")
+        "dcn_dp", "dp_replicate", "dp_shard", "cp")
     assert spec_for(("experts", "embed", "expert_mlp"), rules)[0] == "tp"
     assert spec_for(("act_tokens", "expert_mlp"), rules) == \
         spec_for(("act_tokens", None), rules)   # EP: intermediate unsharded

@@ -104,4 +104,6 @@ def test_state_specs_match_optimizer_tree():
 
 
 def test_batch_spec():
-    assert batch_spec() == P(("dp_replicate", "dp_shard"), "cp")
+    # batch rows shard over every data-parallel axis, incl. the cross-slice
+    # dcn_dp outer axis (hierarchical DP, ISSUE 9)
+    assert batch_spec() == P(("dcn_dp", "dp_replicate", "dp_shard"), "cp")
