@@ -96,7 +96,7 @@ _MOVED_IMPORT_FROMS: Dict[Tuple[str, str], str] = {
 # config domain (the convention CP_LAYOUTS / MOE_DISPATCHES established).
 _ENUM_CONST_RE = re.compile(
     r"^_?[A-Z][A-Z0-9_]*(LAYOUTS|DISPATCHES|MODES|SCHEMES|STRATEGIES|"
-    r"POLICIES|BACKENDS|FORMATS|KINDS|CHOICES)$")
+    r"POLICIES|BACKENDS|FORMATS|KINDS|CHOICES|DTYPES|RECIPES)$")
 
 # L003: banned call chains inside jit scope.
 _WALLCLOCK_CALLS = {

@@ -361,12 +361,15 @@ def _enum_fields():
     truth); resolved lazily to keep this module import-light."""
     from automodel_tpu.ops.kernel_lib.autotune import AUTOTUNE_MODES
     from automodel_tpu.ops.moe import MOE_DISPATCHES
+    from automodel_tpu.ops.quant import QUANT_DTYPES, QUANT_RECIPES
     from automodel_tpu.ops.zigzag import CP_LAYOUTS
 
     return {
         "distributed.cp_layout": CP_LAYOUTS,
         "moe.dispatch": MOE_DISPATCHES,
         "kernels.autotune": AUTOTUNE_MODES,
+        "fp8.dtype": QUANT_DTYPES,
+        "fp8.recipe_name": QUANT_RECIPES,
     }
 
 

@@ -424,13 +424,16 @@ class DeepseekV3ForCausalLM(LlamaForCausalLM):
         xg = constrain(xg, ("act_tokens", None, None))
         weights, idx = self._route(xg, p["gate"], k)
         weights, idx, _ = mask_padded_tokens(weights, idx, pad, E)
+        from automodel_tpu.ops.quant import quant_for
+
         routed = expert_ffn(
             xg, weights, idx,
             p["experts"]["gate_proj"]["kernel"],
             p["experts"]["up_proj"]["kernel"],
             p["experts"]["down_proj"]["kernel"],
             capacity=C, dispatch=cfg.moe_dispatch,
-            compute_dtype=self.compute_dtype)
+            compute_dtype=self.compute_dtype,
+            quant=quant_for(self.quant, "mlp.experts"))
         routed = routed.reshape(-1, H)
         if pad:
             routed = routed[:T]

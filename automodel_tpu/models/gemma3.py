@@ -32,6 +32,7 @@ from jax import lax
 from automodel_tpu.distributed.shardings import constrain
 from automodel_tpu.ops.attention import attention
 from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.quant import maybe_qdot
 from automodel_tpu.ops.rotary import apply_rope, rope_frequencies
 
 @dataclasses.dataclass
@@ -188,14 +189,19 @@ class Gemma3ForCausalLM:
         cd = self.compute_dtype
         eps = cfg.rms_norm_eps
 
-        def proj(x, w):
-            return x @ w["kernel"].astype(cd)
+        def proj(x, w, name=""):
+            # fp8/int8 quantized compute routes through maybe_qdot when
+            # apply_fp8_to_model set self.quant (filter_fqns honored by name)
+            return maybe_qdot(x, w["kernel"].astype(cd), self.quant, name)
 
         resid = hidden
         x = rms_norm(hidden, p["input_layernorm"]["weight"], eps, offset=1.0)
-        q = proj(x, p["self_attn"]["q_proj"]).reshape(B, S, Hq, D)
-        k = proj(x, p["self_attn"]["k_proj"]).reshape(B, S, Hk, D)
-        v = proj(x, p["self_attn"]["v_proj"]).reshape(B, S, Hk, D)
+        q = proj(x, p["self_attn"]["q_proj"],
+                 "self_attn.q_proj").reshape(B, S, Hq, D)
+        k = proj(x, p["self_attn"]["k_proj"],
+                 "self_attn.k_proj").reshape(B, S, Hk, D)
+        v = proj(x, p["self_attn"]["v_proj"],
+                 "self_attn.v_proj").reshape(B, S, Hk, D)
         if cfg.qk_norm:
             q = rms_norm(q, p["self_attn"]["q_norm"]["weight"], eps,
                          offset=1.0)
@@ -246,7 +252,8 @@ class Gemma3ForCausalLM:
                 attention, q, k, v, causal=True, scale=scale_,
                 logits_soft_cap=soft_cap,
                 segment_ids=segment_ids, attention_mask=attention_mask)
-        attn = proj(attn.reshape(B, S, Hq * D), p["self_attn"]["o_proj"])
+        attn = proj(attn.reshape(B, S, Hq * D), p["self_attn"]["o_proj"],
+                    "self_attn.o_proj")
         attn = rms_norm(attn, p["post_attention_layernorm"]["weight"], eps,
                         offset=1.0)
         hidden = resid + attn
@@ -254,10 +261,10 @@ class Gemma3ForCausalLM:
         resid = hidden
         x = rms_norm(hidden, p["pre_feedforward_layernorm"]["weight"], eps,
                      offset=1.0)
-        gate = proj(x, p["mlp"]["gate_proj"])
-        up = proj(x, p["mlp"]["up_proj"])
+        gate = proj(x, p["mlp"]["gate_proj"], "mlp.gate_proj")
+        up = proj(x, p["mlp"]["up_proj"], "mlp.up_proj")
         down = proj(jax.nn.gelu(gate, approximate=True) * up,
-                    p["mlp"]["down_proj"])
+                    p["mlp"]["down_proj"], "mlp.down_proj")
         down = rms_norm(down, p["post_feedforward_layernorm"]["weight"], eps,
                         offset=1.0)
         out = constrain(resid + down, ("act_batch", "act_seq", "act_embed"))

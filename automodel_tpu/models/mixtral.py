@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from automodel_tpu.ops.moe import moe_mlp_block
+from automodel_tpu.ops.quant import quant_for
 
 
 @dataclasses.dataclass
@@ -105,6 +106,7 @@ class MixtralForCausalLM(LlamaForCausalLM):
             group_size=cfg.moe_group_size,
             compute_dtype=self.compute_dtype,
             dispatch=cfg.moe_dispatch,
+            quant=quant_for(self.quant, "block_sparse_moe.experts"),
         )
 
     def _combine_aux(self, aux_losses):

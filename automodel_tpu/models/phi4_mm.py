@@ -461,14 +461,16 @@ class Phi4MMTextModel(LlamaForCausalLM):
             raise NotImplementedError(
                 "rank-r LoRA bypass is not wired for the fused Phi "
                 "projections; use peft merge mode (dropout=0)")
-        if self.quant is not None:
-            raise NotImplementedError(
-                "fp8/int8 quantized compute is not wired for the fused Phi "
-                "projections")
+        from automodel_tpu.ops.quant import maybe_qdot
 
         resid = hidden
         x = rms_norm(hidden, p["input_layernorm"]["weight"], cfg.rms_norm_eps)
-        qkv = x @ p["self_attn"]["qkv_proj"]["kernel"].astype(cd)
+        # Fused projections route through maybe_qdot like the per-module
+        # Llama path: quantization is per-matmul, so the fused qkv/gate_up
+        # kernels are each ONE quantized GEMM (filter_fqns match the fused
+        # module names).
+        qkv = maybe_qdot(x, p["self_attn"]["qkv_proj"]["kernel"].astype(cd),
+                         self.quant, "self_attn.qkv_proj")
         q = qkv[..., :Hq * D].reshape(B, S, Hq, D)
         k = qkv[..., Hq * D:(Hq + Hk) * D].reshape(B, S, Hk, D)
         v = qkv[..., (Hq + Hk) * D:].reshape(B, S, Hk, D)
@@ -476,17 +478,20 @@ class Phi4MMTextModel(LlamaForCausalLM):
         attn, new_cache = self._attention_core(
             q, k, v, segment_ids, attention_mask, kv_cache, cache_index,
             local_window_size=self._sliding_window)
-        attn = attn.reshape(B, S, Hq * D) @ (
-            p["self_attn"]["o_proj"]["kernel"].astype(cd))
+        attn = maybe_qdot(attn.reshape(B, S, Hq * D),
+                          p["self_attn"]["o_proj"]["kernel"].astype(cd),
+                          self.quant, "self_attn.o_proj")
         hidden = resid + attn
 
         resid = hidden
         x = rms_norm(hidden, p["post_attention_layernorm"]["weight"],
                      cfg.rms_norm_eps)
-        gu = x @ p["mlp"]["gate_up_proj"]["kernel"].astype(cd)
+        gu = maybe_qdot(x, p["mlp"]["gate_up_proj"]["kernel"].astype(cd),
+                        self.quant, "mlp.gate_up_proj")
         gate, up = jnp.split(gu, 2, axis=-1)     # decoder order: gate first
-        down = (up * jax.nn.silu(gate)) @ (
-            p["mlp"]["down_proj"]["kernel"].astype(cd))
+        down = maybe_qdot(up * jax.nn.silu(gate),
+                          p["mlp"]["down_proj"]["kernel"].astype(cd),
+                          self.quant, "mlp.down_proj")
         from automodel_tpu.distributed.shardings import constrain
 
         out = constrain(resid + down, ("act_batch", "act_seq", "act_embed"))

@@ -25,6 +25,7 @@ from typing import Tuple
 
 from automodel_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
 from automodel_tpu.ops.moe import moe_mlp_block
+from automodel_tpu.ops.quant import quant_for
 
 
 @dataclasses.dataclass
@@ -117,6 +118,7 @@ class Qwen3MoeForCausalLM(MixtralForCausalLM):
             compute_dtype=self.compute_dtype,
             norm_topk=bool(cfg.norm_topk_prob),
             dispatch=cfg.moe_dispatch,
+            quant=quant_for(self.quant, "mlp.experts"),
         )
 
     def flops_per_token(self) -> float:

@@ -185,7 +185,8 @@ def _row_mask(mid_ref, starts_ref, ends_ref, valid_ref, g, w, tm):
 # Forward kernel: out[rows of g] = lhs[rows of g] @ rhs[g]
 # ---------------------------------------------------------------------------
 def _gmm_kernel(gid_ref, mid_ref, starts_ref, ends_ref, first_ref, last_ref,
-                valid_ref, lhs_ref, rhs_ref, out_ref, acc, *, tm: int):
+                valid_ref, lhs_ref, rhs_ref, out_ref, acc, *, tm: int,
+                acc_t=jnp.float32):
     w = pl.program_id(1)
 
     @pl.when(first_ref[w] == 1)
@@ -195,7 +196,7 @@ def _gmm_kernel(gid_ref, mid_ref, starts_ref, ends_ref, first_ref, last_ref,
     g = gid_ref[w]
     mask = _row_mask(mid_ref, starts_ref, ends_ref, valid_ref, g, w, tm)
     x = jnp.where(mask, lhs_ref[...], jnp.zeros((), lhs_ref.dtype))
-    acc[...] += jnp.dot(x, rhs_ref[0], preferred_element_type=jnp.float32)
+    acc[...] += jnp.dot(x, rhs_ref[0], preferred_element_type=acc_t)
 
     @pl.when(last_ref[w] == 1)
     def _():
@@ -203,9 +204,16 @@ def _gmm_kernel(gid_ref, mid_ref, starts_ref, ends_ref, first_ref, last_ref,
 
 
 def _gmm_pallas(lhs: jnp.ndarray, rhs: jnp.ndarray,
-                group_sizes: jnp.ndarray) -> jnp.ndarray:
+                group_sizes: jnp.ndarray, *,
+                acc_dtype=jnp.float32,
+                out_dtype=None) -> jnp.ndarray:
+    """``acc_dtype``/``out_dtype`` parametrize the quantized rungs
+    (``ops/gmm_quant_kernel.py``): int8 operands accumulate EXACTLY in an
+    int32 VMEM scratch (the native int8 MXU path) and store f32; the
+    defaults are bit-identical to the pre-quantization kernel."""
     m, k = lhs.shape
     E, _, n = rhs.shape
+    out_dtype = lhs.dtype if out_dtype is None else jnp.dtype(out_dtype)
     tm, tn = _tiles(m, k, n)
     mp, np_ = -(-m // tm) * tm, -(-n // tn) * tn
     if mp != m:
@@ -215,7 +223,7 @@ def _gmm_pallas(lhs: jnp.ndarray, rhs: jnp.ndarray,
     meta = _group_tile_metadata(group_sizes, mp, tm)
     grid = (np_ // tn, meta["num_items"])
     out = pl.pallas_call(
-        functools.partial(_gmm_kernel, tm=tm),
+        functools.partial(_gmm_kernel, tm=tm, acc_t=jnp.dtype(acc_dtype)),
         grid_spec=tiling.prefetch_grid_spec(
             num_scalar_prefetch=7,
             grid=grid,
@@ -227,9 +235,9 @@ def _gmm_pallas(lhs: jnp.ndarray, rhs: jnp.ndarray,
             ],
             out_specs=tiling.block_spec(
                 (tm, tn), lambda j, w, gid, mid, *_: (mid[w], j)),
-            scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+            scratch_shapes=[pltpu.VMEM((tm, tn), jnp.dtype(acc_dtype))],
         ),
-        out_shape=jax.ShapeDtypeStruct((mp, np_), lhs.dtype),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
         compiler_params=tiling.compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
@@ -351,6 +359,55 @@ def _gmm_xla_blocked(lhs: jnp.ndarray, rhs: jnp.ndarray,
                      preferred_element_type=jnp.float32)
     out = jnp.where(valid[:, None, None], out, jnp.zeros((), out.dtype))
     return out.reshape(m, n).astype(lhs.dtype)
+
+
+def _tgmm_xla_blocked(lhs: jnp.ndarray, dout: jnp.ndarray,
+                      group_sizes: jnp.ndarray, block: int) -> jnp.ndarray:
+    """Block-aligned XLA tgmm (per-group ``lhs^T @ dout`` -> [E, k, n]):
+    under the same caller promise as :func:`_gmm_xla_blocked` each row block
+    belongs to one group, so the per-group outer products are a batched
+    einsum over blocks scatter-added into the expert slots.  ``O(m*k*n)``
+    like the kernel; consumed by the quantized grouped matmul's backward
+    (``ops/gmm_quant_kernel.py``) where no Pallas path is available."""
+    m, k = lhs.shape
+    n = dout.shape[1]
+    E = group_sizes.shape[0]
+    nb = m // block
+    ends = jnp.cumsum(group_sizes.astype(jnp.int32))
+    gid = jnp.searchsorted(
+        ends, jnp.arange(nb, dtype=jnp.int32) * block, side="right")
+    valid = gid < E
+    prods = jnp.einsum("bmk,bmn->bkn", lhs.reshape(nb, block, k),
+                       dout.reshape(nb, block, n),
+                       preferred_element_type=jnp.float32)
+    prods = jnp.where(valid[:, None, None], prods, jnp.zeros((), prods.dtype))
+    out = jnp.zeros((E, k, n), jnp.float32).at[
+        jnp.minimum(gid, E - 1)].add(prods)
+    return out.astype(lhs.dtype)
+
+
+def tgmm(lhs: jnp.ndarray, dout: jnp.ndarray, group_sizes: jnp.ndarray, *,
+         block_aligned: bool = False, block_rows: int = 128) -> jnp.ndarray:
+    """Per-group ``lhs[rows of e]^T @ dout[rows of e] -> [E, k, n]`` — the
+    grouped wgrad.  Pallas kernel on TPU/interpret; block-aligned XLA
+    fallback under the caller's alignment promise; dense one-hot einsum as
+    the anchor.  Not a registry family of its own: it is only reachable
+    through the gmm/gmm_quant backward passes, whose parity tests execute
+    all three branches."""
+    m, k = lhs.shape
+    n = dout.shape[1]
+    if gmm_kernel_available(m, k, n):
+        return _tgmm_pallas(lhs, dout, group_sizes)
+    if block_aligned and m % block_rows == 0:
+        return _tgmm_xla_blocked(lhs, dout, group_sizes, block_rows)
+    E = group_sizes.shape[0]
+    ends = jnp.cumsum(group_sizes.astype(jnp.int32))
+    starts = ends - group_sizes.astype(jnp.int32)
+    rows = jnp.arange(m, dtype=jnp.int32)
+    onehot = ((rows[:, None] >= starts[None, :])
+              & (rows[:, None] < ends[None, :])).astype(lhs.dtype)  # [m, E]
+    return jnp.einsum("me,mk,mn->ekn", onehot, lhs, dout,
+                      preferred_element_type=jnp.float32).astype(lhs.dtype)
 
 
 def gmm(lhs: jnp.ndarray, rhs: jnp.ndarray, group_sizes: jnp.ndarray, *,

@@ -1,0 +1,229 @@
+"""Quantized grouped matmul — int8/fp8 expert FFNs for sorted MoE dispatch.
+
+The sorted dispatch (``ops/moe.py::sorted_expert_ffn``) runs its three
+SwiGLU grouped matmuls through :func:`gmm_quant` when quantized compute is
+on (``fp8.enabled``): the same (row-tile, group) Pallas schedule as
+``ops/gmm_kernel.py`` — masked straddle tiles, scalar-prefetch-steered
+expert-weight DMA — but on dynamically-quantized operands with exact
+low-precision accumulation (int32 for int8 x int8 on the native int8 MXU
+path, fp32 for fp8), then a broadcast rescale.
+
+Dynamic scales are PER GROUP on the expert-weight side and per row / per
+group on the token side:
+
+* ``rowwise`` — token rows scale individually (amax over the contraction,
+  like qdot's rowwise recipe), expert weights per (expert, out-column);
+* ``tensorwise`` — one scale per GROUP on both sides (a scatter-max over
+  the group's row amaxes stands in for qdot's whole-tensor amax: the
+  grouped matmul is E independent GEMMs, so "tensorwise" is per-expert).
+
+Scales never ride the contraction, so rescaling is
+``out[r, :] * s_lhs[r] * s_rhs[group(r), :]`` after the quantized gmm.
+
+Backward mirrors ``gmm``'s custom VJP: ``dlhs = gmm_quant(dout, rhs^T)``
+with the incoming gradient quantized to e5m2 (int8 for the int8 recipe) and
+the weights to e4m3; ``drhs = tgmm(lhs, dout)`` stays in the compute dtype —
+the wgrad contraction runs over ROWS, where any per-row scale would ride
+the contraction axis, and keeping the weight gradient high-precision is the
+standard fp8-training convergence guard (torchao keeps exactly this shape
+of headroom in its rowwise recipe).
+
+Registry chain (the PR-7 checklist): ``gmm_quant.pallas`` ->
+``gmm_quant.xla_blocked`` (block-aligned einsum on the quantized values,
+f32 compute — the CPU-runnable rung) -> ``gmm_quant.dense`` (one-hot
+segment einsum, the always-available anchor and parity reference).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.ops import gmm_kernel
+from automodel_tpu.ops.kernel_lib import registry
+from automodel_tpu.ops.quant import (
+    accum_dtype,
+    _gemm_dtypes,
+    qmax_for,
+    quant_cast,
+)
+
+
+# ---------------------------------------------------------------------------
+# Per-group dynamic scales
+# ---------------------------------------------------------------------------
+def _row_group_ids(group_sizes: jnp.ndarray, m: int) -> jnp.ndarray:
+    """Group id per buffer row (rows past ``sum(group_sizes)`` get E)."""
+    ends = jnp.cumsum(group_sizes.astype(jnp.int32))
+    return jnp.searchsorted(ends, jnp.arange(m, dtype=jnp.int32),
+                            side="right").astype(jnp.int32)
+
+
+def lhs_scales(lhs: jnp.ndarray, group_sizes: jnp.ndarray, qdtype,
+               recipe: str) -> jnp.ndarray:
+    """Per-row scale column [m, 1]: each row's own amax (``rowwise``) or its
+    group's amax via scatter-max (``tensorwise``).  Tail/empty slots get
+    scale 1 so the divide stays finite (their rows are zero anyway)."""
+    m = lhs.shape[0]
+    qmax = qmax_for(qdtype)
+    row_amax = jnp.max(jnp.abs(lhs.astype(jnp.float32)), axis=1)     # [m]
+    if recipe == "rowwise":
+        return (jnp.maximum(row_amax, 1e-12) / qmax)[:, None]
+    E = group_sizes.shape[0]
+    gid = _row_group_ids(group_sizes, m)
+    group_amax = jnp.zeros((E + 1,), jnp.float32).at[gid].max(row_amax)
+    per_row = jnp.take(jnp.maximum(group_amax, 1e-12), gid)
+    return (per_row / qmax)[:, None]
+
+
+def rhs_scales(rhs: jnp.ndarray, qdtype, recipe: str) -> jnp.ndarray:
+    """Expert-weight scales [E, 1, n] (``rowwise``: per out-column) or
+    [E, 1, 1] (``tensorwise``: per expert)."""
+    qmax = qmax_for(qdtype)
+    if recipe == "rowwise":
+        a = jnp.max(jnp.abs(rhs.astype(jnp.float32)), axis=1, keepdims=True)
+    else:
+        a = jnp.max(jnp.abs(rhs.astype(jnp.float32)), axis=(1, 2),
+                    keepdims=True)
+    return jnp.maximum(a, 1e-12) / qmax
+
+
+def _rescale(raw: jnp.ndarray, s_lhs: jnp.ndarray, s_rhs: jnp.ndarray,
+             group_sizes: jnp.ndarray) -> jnp.ndarray:
+    """``raw [m, n] * s_lhs [m, 1] * s_rhs[group(row)]`` (tail rows are
+    already zero from the kernel's row mask)."""
+    E = group_sizes.shape[0]
+    gid = jnp.minimum(_row_group_ids(group_sizes, raw.shape[0]), E - 1)
+    per_row_rhs = jnp.take(s_rhs[:, 0, :], gid, axis=0)      # [m, n|1]
+    return raw.astype(jnp.float32) * s_lhs * per_row_rhs
+
+
+# ---------------------------------------------------------------------------
+# The quantized grouped matmul (one direction); rungs differ only in how
+# they multiply the already-quantized operands.
+# ---------------------------------------------------------------------------
+def _quantized_gmm(lhs, rhs, group_sizes, *, a_qdtype, b_qdtype, recipe,
+                   block_aligned, block_rows):
+    s_lhs = lhs_scales(lhs, group_sizes, a_qdtype, recipe)
+    s_rhs = rhs_scales(rhs, b_qdtype, recipe)
+    lhs_q = quant_cast(lhs, s_lhs, a_qdtype)
+    rhs_q = quant_cast(rhs, s_rhs, b_qdtype)
+    m, k = lhs.shape
+    n = rhs.shape[-1]
+    request = {"kind": "gmm_quant", "m": m, "k": k, "n": n,
+               "a_dtype": str(jnp.dtype(a_qdtype)),
+               "b_dtype": str(jnp.dtype(b_qdtype)),
+               "block_aligned": bool(block_aligned),
+               "block_rows": int(block_rows)}
+    raw = registry.dispatch("gmm_quant.pallas", request, lhs_q, rhs_q,
+                            group_sizes)
+    return _rescale(raw, s_lhs, s_rhs, group_sizes)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def gmm_quant(lhs: jnp.ndarray, rhs: jnp.ndarray, group_sizes: jnp.ndarray,
+              dtype: str = "float8", recipe: str = "tensorwise",
+              block_aligned: bool = False,
+              block_rows: int = 128) -> jnp.ndarray:
+    """Quantized :func:`ops.gmm_kernel.gmm`: rows of ``lhs`` [m, k] are
+    contiguous per-group segments sized by ``group_sizes`` [E], each
+    multiplying ``rhs`` [E, k, n] on the int8/fp8 MXU path with per-group
+    dynamic scales.  Differentiable: dgrad quantized (e5m2 grads), wgrad in
+    the input dtype (see module docstring).  Returns ``lhs.dtype``."""
+    a_q, b_q = _gemm_dtypes(dtype, None)
+    out = _quantized_gmm(lhs, rhs, group_sizes, a_qdtype=a_q, b_qdtype=b_q,
+                         recipe=recipe, block_aligned=block_aligned,
+                         block_rows=block_rows)
+    return out.astype(lhs.dtype)
+
+
+def _gmm_quant_fwd(lhs, rhs, group_sizes, dtype, recipe, block_aligned,
+                   block_rows):
+    return (gmm_quant(lhs, rhs, group_sizes, dtype, recipe, block_aligned,
+                      block_rows),
+            (lhs, rhs, group_sizes))
+
+
+def _gmm_quant_bwd(dtype, recipe, block_aligned, block_rows, res, dout):
+    lhs, rhs, group_sizes = res
+    dout = dout.astype(lhs.dtype)
+    a_q, b_q = _gemm_dtypes(dtype, "a")     # incoming grad is operand a
+    dlhs = _quantized_gmm(
+        dout, jnp.swapaxes(rhs, 1, 2), group_sizes, a_qdtype=a_q,
+        b_qdtype=b_q, recipe=recipe, block_aligned=block_aligned,
+        block_rows=block_rows)
+    drhs = gmm_kernel.tgmm(lhs, dout, group_sizes,
+                           block_aligned=block_aligned,
+                           block_rows=block_rows)
+    return (dlhs.astype(lhs.dtype), drhs.astype(rhs.dtype),
+            np.zeros(group_sizes.shape, jax.dtypes.float0))
+
+
+gmm_quant.defvjp(_gmm_quant_fwd, _gmm_quant_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Registry rungs: quantized-operand grouped matmuls ([m,k]q x [E,k,n]q ->
+# f32 raw, rescaled by the caller)
+# ---------------------------------------------------------------------------
+def _acc(request):
+    return accum_dtype(jnp.dtype(request["a_dtype"]),
+                       jnp.dtype(request["b_dtype"]))
+
+
+def _gmm_quant_pallas_probe(request) -> bool:
+    return gmm_kernel.gmm_kernel_available(
+        request["m"], request["k"], request["n"])
+
+
+def _gmm_quant_pallas_impl(request, lhs_q, rhs_q, group_sizes):
+    return gmm_kernel._gmm_pallas(lhs_q, rhs_q, group_sizes,
+                                  acc_dtype=_acc(request),
+                                  out_dtype=jnp.float32)
+
+
+def _gmm_quant_blocked_probe(request) -> bool:
+    return (request.get("block_aligned", False)
+            and request["m"] % request.get("block_rows", 128) == 0)
+
+
+def _gmm_quant_blocked_impl(request, lhs_q, rhs_q, group_sizes):
+    # f32 compute on the quantized VALUES: same rounded/clipped numbers as
+    # the kernel, accumulation order aside (exact for int8 at k*127^2 <
+    # 2^24) — the CPU-runnable rung.
+    return gmm_kernel._gmm_xla_blocked(
+        lhs_q.astype(jnp.float32), rhs_q.astype(jnp.float32), group_sizes,
+        request.get("block_rows", 128))
+
+
+def _gmm_quant_dense(request, lhs_q, rhs_q, group_sizes):
+    """Dense one-hot oracle on the quantized values — anchor rung and the
+    family's parity reference."""
+    return gmm_kernel._gmm_reference(
+        request, lhs_q.astype(jnp.float32), rhs_q.astype(jnp.float32),
+        group_sizes)
+
+
+def _gmm_quant_dense_probe(request) -> bool:
+    return True
+
+
+# Autotune: the quantized rung rides the SAME (row-tile, col-tile) schedule
+# and byte model as the bf16 gmm (operands are smaller, never larger), so it
+# shares the "gmm" sweep key instead of registering a second adapter —
+# one sweep warms both precisions.
+
+registry.register_kernel(
+    "gmm_quant.pallas", probe=_gmm_quant_pallas_probe,
+    impl=_gmm_quant_pallas_impl, fallback="gmm_quant.xla_blocked",
+    reference=_gmm_quant_dense)
+registry.register_kernel(
+    "gmm_quant.xla_blocked", probe=_gmm_quant_blocked_probe,
+    impl=_gmm_quant_blocked_impl, fallback="gmm_quant.dense",
+    reference=_gmm_quant_dense)
+registry.register_kernel(
+    "gmm_quant.dense", probe=_gmm_quant_dense_probe, impl=_gmm_quant_dense,
+    fallback=None, reference=_gmm_quant_dense)

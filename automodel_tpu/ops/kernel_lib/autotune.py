@@ -470,10 +470,14 @@ def training_sweep_requests(model, seq_len: Optional[int],
             "num_q_heads": hq, "num_kv_heads": hk, "causal": True,
             "batch": max(local_batch, 1), "dtype": dtype}))
     elif hq and d:
-        out.append(("splash", {
+        splash_req = {
             "q_seq": seq_len, "kv_seq": seq_len, "head_dim": d,
             "num_q_heads": hq, "num_kv_heads": hk, "causal": True,
-            "batch": max(local_batch, 1), "dtype": dtype}))
+            "batch": max(local_batch, 1), "dtype": dtype}
+        out.append(("splash", splash_req))
+        # the fused backward's own triple (block_q_dkv / block_kv_dkv)
+        # sweeps under its own key — same request shape
+        out.append(("splash_bwd", dict(splash_req)))
     vocab = getattr(cfg, "vocab_size", None)
     if hidden and vocab and hidden % 128 == 0:
         out.append(("linear_ce", {
@@ -493,4 +497,33 @@ def training_sweep_requests(model, seq_len: Optional[int],
                             "num_groups": n_exp, "dtype": dtype}))
         out.append(("gmm", {"m": rows, "k": moe_i, "n": hidden,
                             "num_groups": n_exp, "dtype": dtype}))
+    # Quantized compute (fp8.enabled): the dense projections route through
+    # qdot, whose custom VJP issues THREE GEMMs per projection [K, N] —
+    # fwd (rows, K, N), dgrad (rows, N, K) and wgrad (K, rows, N) — each
+    # with its own (m-bucket, k, n) cache key, so a pre-warm must plan all
+    # three or the backward lookups stay cold after a full sweep.  The
+    # quantized grouped matmul shares the "gmm" key above (same schedule,
+    # smaller operands).
+    quant = getattr(model, "quant", None)
+    inter = getattr(cfg, "intermediate_size", None)
+    if (quant is not None and getattr(quant, "enabled", False)
+            and hidden and inter and hidden % 128 == 0 and inter % 128 == 0):
+        # seq_len % 128 == 0 is enforced at entry, so the wgrad GEMM's
+        # row-count contraction (k = rows) is lane-aligned by construction
+        rows = max(local_batch, 1) * seq_len
+        pairs = {(hidden, inter), (inter, hidden)}      # gate/up, down
+        if hq and d and (hq * d) % 128 == 0:
+            pairs |= {(hidden, hq * d), (hq * d, hidden)}   # qkv-ish, o
+            if hk and (hk * d) % 128 == 0:
+                pairs.add((hidden, hk * d))                 # k/v (GQA)
+        seen = set()
+        for K, N in sorted(pairs):
+            for m, k, n in ((rows, K, N), (rows, N, K), (K, rows, N)):
+                key = (shape_bucket(m), k, n)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(("qdot", {"m": m, "k": k, "n": n,
+                                     "quant_dtype": quant.dtype,
+                                     "recipe": quant.recipe_name}))
     return out

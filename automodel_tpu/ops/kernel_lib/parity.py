@@ -37,12 +37,15 @@ CPU_EXECUTABLE = {
     "attention.splash", "attention.ring", "attention.sdpa",
     "linear_ce.pallas", "linear_ce.chunked",
     "gmm.pallas", "gmm.xla_blocked", "gmm.ragged",
+    "qdot.pallas", "qdot.xla",
+    "gmm_quant.pallas", "gmm_quant.xla_blocked", "gmm_quant.dense",
 }
 
 _INTERPRET_MODULES = (
     "automodel_tpu.ops.splash_attention",
     "automodel_tpu.ops.linear_ce_kernel",
     "automodel_tpu.ops.gmm_kernel",
+    "automodel_tpu.ops.qdot_kernel",
 )
 
 
@@ -235,6 +238,92 @@ def run_gmm_parity(spec_name: str, case: Dict) -> None:
     ref = spec.reference(request, lhs, rhs, sizes) if spec.reference \
         else registry.get_kernel("gmm.pallas").reference(
             request, lhs, rhs, sizes)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4,
+                               err_msg=f"{spec_name} on {case['name']}")
+
+
+# ---------------------------------------------------------------------------
+# qdot family (quantized matmul)
+# ---------------------------------------------------------------------------
+def qdot_cases() -> List[Dict]:
+    """Recipe x dtype matrix for the fused quantized matmul — every case
+    pins the Pallas rung's in-VMEM quantize/dot/rescale against the XLA
+    rung's three-step spelling of the SAME math (int8 is bit-exact: both
+    accumulate int8 products in int32)."""
+    return [
+        dict(name="int8_tensorwise", m=128, k=128, n=256,
+             a_dtype="int8", b_dtype="int8", rowwise=False),
+        dict(name="int8_rowwise", m=200, k=128, n=256,
+             a_dtype="int8", b_dtype="int8", rowwise=True),
+        dict(name="fp8_tensorwise", m=128, k=128, n=128,
+             a_dtype="float8_e4m3fn", b_dtype="float8_e4m3fn",
+             rowwise=False),
+        dict(name="fp8_rowwise_e5m2_grad", m=128, k=128, n=128,
+             a_dtype="float8_e5m2", b_dtype="float8_e4m3fn", rowwise=True),
+    ]
+
+
+def run_qdot_parity(spec_name: str, case: Dict) -> None:
+    from automodel_tpu.ops.quant import _operand_scales
+
+    spec = registry.get_kernel(spec_name)
+    rng = np.random.default_rng(2)
+    m, k, n = case["m"], case["k"], case["n"]
+    a = jnp.asarray(rng.normal(size=(m, k)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(k, n)) * 0.1, jnp.float32)
+    sa, sb = _operand_scales(a, b, jnp.dtype(case["a_dtype"]),
+                             jnp.dtype(case["b_dtype"]), case["rowwise"])
+    request = {"kind": "qdot", "m": m, "k": k, "n": n,
+               "a_dtype": case["a_dtype"], "b_dtype": case["b_dtype"],
+               "rowwise": case["rowwise"]}
+    with interpret_mode():
+        out = spec.impl(request, a, b, sa, sb)
+    ref = spec.reference(request, a, b, sa, sb)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5,
+                               err_msg=f"{spec_name} on {case['name']}")
+
+
+# ---------------------------------------------------------------------------
+# gmm_quant family (quantized grouped matmul)
+# ---------------------------------------------------------------------------
+def gmm_quant_cases() -> List[Dict]:
+    return [
+        dict(name="int8_tensorwise_ragged", m=256, k=128, n=128,
+             sizes=(96, 0, 100, 32), dtype="int8", recipe="tensorwise"),
+        dict(name="int8_rowwise_block_aligned", m=512, k=128, n=128,
+             sizes=(128, 256, 0, 128), dtype="int8", recipe="rowwise",
+             block_aligned=True),
+        dict(name="fp8_tensorwise_block_aligned", m=256, k=128, n=128,
+             sizes=(128, 0, 128, 0), dtype="float8", recipe="tensorwise",
+             block_aligned=True),
+    ]
+
+
+def run_gmm_quant_parity(spec_name: str, case: Dict) -> None:
+    from automodel_tpu.ops.gmm_quant_kernel import lhs_scales, rhs_scales
+    from automodel_tpu.ops.quant import _gemm_dtypes, quant_cast
+
+    spec = registry.get_kernel(spec_name)
+    rng = np.random.default_rng(3)
+    m, k, n = case["m"], case["k"], case["n"]
+    sizes = jnp.asarray(case["sizes"], jnp.int32)
+    lhs = jnp.asarray(rng.normal(size=(m, k)) * 0.5, jnp.float32)
+    rhs = jnp.asarray(rng.normal(size=(len(case["sizes"]), k, n)) * 0.1,
+                      jnp.float32)
+    a_q, b_q = _gemm_dtypes(case["dtype"], None)
+    lhs_q = quant_cast(lhs, lhs_scales(lhs, sizes, a_q, case["recipe"]), a_q)
+    rhs_q = quant_cast(rhs, rhs_scales(rhs, b_q, case["recipe"]), b_q)
+    request = {"kind": "gmm_quant", "m": m, "k": k, "n": n,
+               "a_dtype": str(jnp.dtype(a_q)), "b_dtype": str(jnp.dtype(b_q)),
+               "block_aligned": bool(case.get("block_aligned")),
+               "block_rows": 128}
+    if spec_name == "gmm_quant.xla_blocked" and not request["block_aligned"]:
+        return      # that rung's contract requires block-aligned groups
+    with interpret_mode():
+        out = spec.impl(request, lhs_q, rhs_q, sizes)
+    ref = spec.reference(request, lhs_q, rhs_q, sizes)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4,
                                err_msg=f"{spec_name} on {case['name']}")

@@ -150,6 +150,10 @@ _DEFAULT_KERNEL_MODULES = (
      "linear_ce.chunked"),
     ("automodel_tpu.loss.linear_ce", "linear_ce.chunked", None),
     ("automodel_tpu.ops.gmm_kernel", "gmm.pallas", "gmm.xla_blocked"),
+    ("automodel_tpu.ops.qdot_kernel", "qdot.pallas", "qdot.xla"),
+    ("automodel_tpu.ops.quant", "qdot.xla", None),
+    ("automodel_tpu.ops.gmm_quant_kernel", "gmm_quant.pallas",
+     "gmm_quant.xla_blocked"),
 )
 
 

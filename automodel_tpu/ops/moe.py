@@ -194,6 +194,7 @@ def moe_mlp_block(
     compute_dtype: jnp.dtype = jnp.bfloat16,
     norm_topk: bool = True,
     dispatch: Optional[str] = None,
+    quant=None,
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """Top-k routed SwiGLU expert FFN.  Returns ``(out [B, S, H],
     (tokens_per_expert [k, E], router_prob [E]))`` — see
@@ -206,6 +207,11 @@ def moe_mlp_block(
 
     ``dispatch``: ``sorted`` (default) | ``onehot`` — see the module
     docstring and :func:`expert_ffn`.
+
+    ``quant``: an enabled :class:`~automodel_tpu.ops.quant.QuantConfig`
+    routes the sorted path's grouped matmuls through the int8/fp8
+    ``gmm_quant`` chain (models pass theirs through
+    ``quant_for(self.quant, "<experts fqn>")`` so ``filter_fqns`` applies).
     """
     B, S, H = x.shape
     E = gate_kernel.shape[-1]
@@ -226,7 +232,8 @@ def moe_mlp_block(
     weights, idx, valid = mask_padded_tokens(weights, idx, pad, E)
     aux = routing_stats(probs, idx, E, valid_tokens=valid)
     out = expert_ffn(xg, weights, idx, w_gate, w_up, w_down,
-                     capacity=C, dispatch=dispatch, compute_dtype=cd)
+                     capacity=C, dispatch=dispatch, compute_dtype=cd,
+                     quant=quant)
     out = out.reshape(-1, H)
     if pad:
         out = out[:T]
@@ -244,16 +251,22 @@ def expert_ffn(
     capacity: int,
     dispatch: Optional[str] = None,
     compute_dtype: jnp.dtype = jnp.bfloat16,
+    quant=None,
 ) -> jnp.ndarray:
     """Routing-agnostic expert-FFN dispatcher (shared by Mixtral softmax
     top-k and the DeepSeek sigmoid/softmax gates): ``sorted`` grouped-matmul
-    path by default, ``onehot`` GShard dispatch/combine as the oracle."""
+    path by default, ``onehot`` GShard dispatch/combine as the oracle.
+
+    ``quant`` applies to the sorted path only: the onehot formulation is
+    kept as the bf16 parity ORACLE the quantized run is measured against,
+    so it never quantizes."""
     if resolve_moe_dispatch(dispatch) == "onehot":
         return expert_dispatch_ffn(xg, weights, idx, w_gate, w_up, w_down,
                                    capacity=capacity,
                                    compute_dtype=compute_dtype)
     return sorted_expert_ffn(xg, weights, idx, w_gate, w_up, w_down,
-                             capacity=capacity, compute_dtype=compute_dtype)
+                             capacity=capacity, compute_dtype=compute_dtype,
+                             quant=quant)
 
 
 def expert_dispatch_ffn(
@@ -328,6 +341,7 @@ def sorted_expert_ffn(
     capacity: Optional[int] = None,
     compute_dtype: jnp.dtype = jnp.bfloat16,
     block_rows: int = 128,
+    quant=None,
 ) -> jnp.ndarray:
     """Sort-based expert FFN: ``O(T*k*H*I)`` compute, no ``[.., E, C]``
     tensors.
@@ -352,6 +366,7 @@ def sorted_expert_ffn(
     """
     G, M, H = xg.shape
     E = w_gate.shape[0]
+    I_mlp = w_gate.shape[-1]
     k = idx.shape[-1]
     T = G * M
     N = T * k
@@ -394,10 +409,26 @@ def sorted_expert_ffn(
     from automodel_tpu.ops.gmm_kernel import gmm
 
     wg, wu, wd = (w.astype(cd) for w in (w_gate, w_up, w_down))
-    h_gate = gmm(x_sorted, wg, padded, block_aligned=True, block_rows=B)
-    h_up = gmm(x_sorted, wu, padded, block_aligned=True, block_rows=B)
+    # Quantized compute (``fp8.enabled``): the three grouped matmuls run on
+    # the int8/fp8 path with per-group dynamic scales.  The 16-alignment
+    # gate mirrors maybe_qdot's torchao rule; the combine/scatter stays in
+    # compute dtype either way.
+    if (quant is not None and getattr(quant, "enabled", False)
+            and H % 16 == 0 and I_mlp % 16 == 0):
+        from automodel_tpu.ops.gmm_quant_kernel import gmm_quant
+
+        def _mm(lhs, rhs):
+            return gmm_quant(lhs, rhs, padded, quant.dtype,
+                             quant.recipe_name, block_aligned=True,
+                             block_rows=B)
+    else:
+        def _mm(lhs, rhs):
+            return gmm(lhs, rhs, padded, block_aligned=True, block_rows=B)
+
+    h_gate = _mm(x_sorted, wg)
+    h_up = _mm(x_sorted, wu)
     h_act = constrain(jax.nn.silu(h_gate) * h_up, ("act_tokens", "expert_mlp"))
-    out_sorted = gmm(h_act, wd, padded, block_aligned=True, block_rows=B)
+    out_sorted = _mm(h_act, wd)
     out_sorted = constrain(out_sorted, ("act_tokens", None))
 
     w_sorted = jnp.where(in_seg, jnp.take(weights.reshape(N), src),

@@ -11,19 +11,29 @@ custom VJP that quantizes all three GEMMs (fwd, dgrad, wgrad):
 
 Scaling is dynamic per call — ``tensorwise`` (one scale per operand, the
 torchao default recipe) or ``rowwise`` (per contraction row/column, better
-accuracy).  On MXU generations without native fp8 (v5e) XLA emulates the
-fp8 dot; ``int8`` uses the int8 MXU path and is the recipe that pays off on
-v5e.
+accuracy).  ``int8`` uses the int8 MXU path and is the recipe that pays off
+on v5e; fp8 targets the native-fp8 generations (v5p+).
+
+Each GEMM is dispatched through the kernel-substrate registry
+(``ops/kernel_lib/registry``): the ``qdot.pallas`` rung
+(``ops/qdot_kernel.py`` — fused quantize -> int8/fp8 dot -> rescale in one
+kernel) falls back to the ``qdot.xla`` rung registered HERE (plain
+``dot_general`` on XLA-quantized operands — always available, jnp-only, and
+the chain's parity reference).  Every GEMM is normalized to
+``a[m, k] @ b[k, n]`` with per-operand quantized dtypes and broadcast-ready
+scale columns/rows, so one request schema covers fwd/dgrad/wgrad.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Literal, Optional
+from typing import Literal, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from automodel_tpu.ops.kernel_lib import registry
 
 E4M3_MAX = 448.0
 E5M2_MAX = 57344.0
@@ -31,97 +41,216 @@ INT8_MAX = 127.0
 
 Recipe = Literal["tensorwise", "rowwise"]
 
+# ``fp8.dtype`` / ``fp8.recipe_name`` config domains (enum-validated at
+# config load like cp_layout / moe.dispatch — see loader._enum_fields).
+QUANT_DTYPES = ("float8", "int8")
+QUANT_RECIPES = ("tensorwise", "rowwise")
+DEFAULT_QUANT_DTYPE = "float8"
+DEFAULT_QUANT_RECIPE = "tensorwise"
+
+
+def normalize_quant_dtype(v):
+    """YAML null spellings -> None (single rule:
+    ``config/loader.normalize_null_spelling``)."""
+    from automodel_tpu.config.loader import normalize_null_spelling
+
+    return normalize_null_spelling(v)
+
+
+def validate_quant_dtype(v: Optional[str]) -> Optional[str]:
+    if v is None:
+        return None
+    if v not in QUANT_DTYPES:
+        raise ValueError(
+            f"fp8.dtype must be one of {list(QUANT_DTYPES)}, got {v!r}")
+    return v
+
+
+def normalize_quant_recipe(v):
+    from automodel_tpu.config.loader import normalize_null_spelling
+
+    return normalize_null_spelling(v)
+
+
+def validate_quant_recipe(v: Optional[str]) -> Optional[str]:
+    if v is None:
+        return None
+    if v not in QUANT_RECIPES:
+        raise ValueError(
+            f"fp8.recipe_name must be one of {list(QUANT_RECIPES)}, "
+            f"got {v!r}")
+    return v
+
 
 @dataclasses.dataclass
 class QuantConfig:
     """Shared knob set for fp8/int8 compute (YAML: ``fp8:`` section)."""
 
     enabled: bool = False
-    recipe_name: Recipe = "tensorwise"
-    dtype: str = "float8"      # "float8" | "int8"
+    recipe_name: Recipe = DEFAULT_QUANT_RECIPE
+    dtype: str = DEFAULT_QUANT_DTYPE   # "float8" | "int8"
     filter_fqns: list = dataclasses.field(default_factory=list)
     emulate: bool = False      # accepted for reference parity; XLA decides
 
+    def __post_init__(self):
+        self.recipe_name = (validate_quant_recipe(
+            normalize_quant_recipe(self.recipe_name))
+            or DEFAULT_QUANT_RECIPE)
+        self.dtype = (validate_quant_dtype(normalize_quant_dtype(self.dtype))
+                      or DEFAULT_QUANT_DTYPE)
 
-def _amax(x: jnp.ndarray, axis: Optional[int], keepdims: bool) -> jnp.ndarray:
+
+def quant_for(cfg: Optional[QuantConfig], name: str
+              ) -> Optional[QuantConfig]:
+    """``cfg`` unless quantized compute is off or ``name`` matches
+    ``filter_fqns`` — the ONE filtering rule, shared by the dense
+    projections (:func:`maybe_qdot`) and the MoE grouped matmuls
+    (``ops/moe.py``)."""
+    if cfg is None or not cfg.enabled:
+        return None
+    if any(f in name for f in cfg.filter_fqns):
+        return None
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# Quantization helpers (shared by qdot, the Pallas rung and the MoE grouped
+# matmuls)
+# ---------------------------------------------------------------------------
+def qmax_for(qdtype) -> float:
+    qdtype = jnp.dtype(qdtype)
+    if qdtype == jnp.int8:
+        return INT8_MAX
+    if qdtype == jnp.float8_e5m2:
+        return E5M2_MAX
+    return E4M3_MAX
+
+
+def _amax(x: jnp.ndarray, axis, keepdims: bool) -> jnp.ndarray:
     a = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=keepdims)
     return jnp.maximum(a, 1e-12)
+
+
+def quant_cast(x: jnp.ndarray, scale: jnp.ndarray, qdtype) -> jnp.ndarray:
+    """``x / scale`` rounded/clipped into ``qdtype`` (int8: round-to-nearest
+    then clip; fp8: clip then downcast).  Pure jnp — runs identically inside
+    the Pallas rung and the XLA rung, so the two can never disagree on the
+    quantization itself, only on accumulation order."""
+    qdtype = jnp.dtype(qdtype)
+    qmax = qmax_for(qdtype)
+    xs = x.astype(jnp.float32) / scale
+    if qdtype == jnp.int8:
+        return jnp.clip(jnp.round(xs), -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return jnp.clip(xs, -qmax, qmax).astype(qdtype)
 
 
 def _quantize(x: jnp.ndarray, qmax: float, qdtype, axis: Optional[int]):
     """Returns (quantized, scale) with scale shaped for broadcast on `axis`
     reduction (None -> scalar tensorwise scale)."""
     scale = _amax(x, axis, keepdims=axis is not None) / qmax
-    xs = x.astype(jnp.float32) / scale
-    if qdtype == jnp.int8:
-        q = jnp.clip(jnp.round(xs), -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return quant_cast(x, scale, qdtype), scale
+
+
+def _operand_scales(a: jnp.ndarray, b: jnp.ndarray, a_qdtype, b_qdtype,
+                    rowwise: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Broadcast-ready dynamic scales for ``a[m, k] @ b[k, n]``:
+    ``sa [m, 1] | [1, 1]`` and ``sb [1, n] | [1, 1]`` — rowwise scales live
+    on the OUTPUT dims, never on the contraction, so the rescale is always
+    ``out * sa * sb``."""
+    if rowwise:
+        sa = _amax(a, axis=1, keepdims=True) / qmax_for(a_qdtype)    # [m, 1]
+        sb = _amax(b, axis=0, keepdims=True) / qmax_for(b_qdtype)    # [1, n]
     else:
-        q = jnp.clip(xs, -qmax, qmax).astype(qdtype)
-    return q, scale
+        sa = _amax(a, axis=None, keepdims=False).reshape(1, 1) \
+            / qmax_for(a_qdtype)
+        sb = _amax(b, axis=None, keepdims=False).reshape(1, 1) \
+            / qmax_for(b_qdtype)
+    return sa, sb
 
 
-def _qdot_fwd_impl(x, w, fwd_dtype, qmax, rowwise):
-    """x: [..., K], w: [K, N] -> [..., N]."""
-    xq, sx = _quantize(x, qmax, fwd_dtype, axis=-1 if rowwise else None)
-    # rowwise for w: per-output-column scale (axis 0 is the contraction)
-    wq, sw = _quantize(w, qmax, fwd_dtype, axis=0 if rowwise else None)
-    out = jax.lax.dot_general(
-        xq, wq, (((xq.ndim - 1,), (0,)), ((), ())),
-        # int32 accumulation keeps the dot on the native int8 MXU path
-        preferred_element_type=jnp.int32 if fwd_dtype == jnp.int8 else jnp.float32)
-    return out.astype(jnp.float32) * sx * sw
+def accum_dtype(a_qdtype, b_qdtype):
+    """int32 keeps an int8 x int8 dot on the native int8 MXU path and is
+    exact; any fp8 operand accumulates fp32."""
+    if jnp.dtype(a_qdtype) == jnp.int8 and jnp.dtype(b_qdtype) == jnp.int8:
+        return jnp.int32
+    return jnp.float32
+
+
+def quantized_matmul(a: jnp.ndarray, b: jnp.ndarray, *,
+                     a_qdtype, b_qdtype, rowwise: bool) -> jnp.ndarray:
+    """One dynamically-scaled quantized GEMM ``a[m, k] @ b[k, n] -> f32``,
+    dispatched through the ``qdot.pallas -> qdot.xla`` registry chain.
+    Callers pre-transpose operands into this layout (fwd/dgrad/wgrad all
+    reduce to it); scales are computed HERE so every rung quantizes the
+    same numbers."""
+    m, k = a.shape
+    n = b.shape[1]
+    sa, sb = _operand_scales(a, b, a_qdtype, b_qdtype, rowwise)
+    request = {"kind": "qdot", "m": m, "k": k, "n": n,
+               "a_dtype": str(jnp.dtype(a_qdtype)),
+               "b_dtype": str(jnp.dtype(b_qdtype)),
+               "rowwise": bool(rowwise)}
+    return registry.dispatch("qdot.pallas", request, a, b, sa, sb)
+
+
+def _gemm_dtypes(dtype: str, grad_operand: Optional[str]):
+    """(a_qdtype, b_qdtype) for one of the three GEMMs: ``grad_operand``
+    names which side carries the incoming gradient ("a" | "b" | None) —
+    grads quantize to e5m2 (wider range), weights/activations to e4m3;
+    int8 uses int8 throughout."""
+    if dtype == "int8":
+        return jnp.int8, jnp.int8
+    g, o = jnp.float8_e5m2, jnp.float8_e4m3fn
+    if grad_operand == "a":
+        return g, o
+    if grad_operand == "b":
+        return o, g
+    return o, o
+
+
+# ---------------------------------------------------------------------------
+# qdot: the custom-VJP quantized drop-in for ``x @ w``
+# ---------------------------------------------------------------------------
+def qdot(x: jnp.ndarray, w: jnp.ndarray, recipe: Recipe = "tensorwise",
+         dtype: str = "float8") -> jnp.ndarray:
+    """Quantized ``x @ w`` ([..., K] @ [K, N]) with the 3-GEMM custom VJP."""
+    return _qdot(x, w, recipe, dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def qdot(x: jnp.ndarray, w: jnp.ndarray, recipe: Recipe = "tensorwise",
-         dtype: str = "float8") -> jnp.ndarray:
-    fwd_dtype = jnp.int8 if dtype == "int8" else jnp.float8_e4m3fn
-    qmax = INT8_MAX if dtype == "int8" else E4M3_MAX
-    out = _qdot_fwd_impl(x, w, fwd_dtype, qmax, recipe == "rowwise")
-    return out.astype(x.dtype)
+def _qdot(x, w, recipe, dtype):
+    rowwise = recipe == "rowwise"
+    a_q, b_q = _gemm_dtypes(dtype, None)
+    x2 = x.reshape(-1, x.shape[-1])
+    out = quantized_matmul(x2, w, a_qdtype=a_q, b_qdtype=b_q,
+                           rowwise=rowwise)
+    return out.reshape(*x.shape[:-1], w.shape[-1]).astype(x.dtype)
 
 
 def _qdot_fwd(x, w, recipe, dtype):
-    return qdot(x, w, recipe, dtype), (x, w)
+    return _qdot(x, w, recipe, dtype), (x, w)
 
 
 def _qdot_bwd(recipe, dtype, res, g):
     x, w = res
     rowwise = recipe == "rowwise"
-    if dtype == "int8":
-        g_dtype, g_max = jnp.int8, INT8_MAX
-        o_dtype, o_max = jnp.int8, INT8_MAX
-    else:
-        g_dtype, g_max = jnp.float8_e5m2, E5M2_MAX
-        o_dtype, o_max = jnp.float8_e4m3fn, E4M3_MAX
 
-    # dx = g @ w.T  (contract over N)
-    acc = jnp.int32 if dtype == "int8" else jnp.float32
-    gq, sg = _quantize(g, g_max, g_dtype, axis=-1 if rowwise else None)
-    wq, sw = _quantize(w, o_max, o_dtype, axis=1 if rowwise else None)
-    dx = jax.lax.dot_general(
-        gq, wq, (((gq.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=acc).astype(jnp.float32)
-    dx = (dx * sg * sw.reshape((1,) * (dx.ndim - 1) + (-1,))
-          if rowwise else dx * sg * sw)
-
-    # dw = x.T @ g  (contract over batch dims)
-    batch_axes = tuple(range(x.ndim - 1))
-    x2 = x.reshape(-1, x.shape[-1])
+    # dx = g @ w.T  (contract over N; g is the gradient operand)
     g2 = g.reshape(-1, g.shape[-1])
-    xq, sx = _quantize(x2, o_max, o_dtype, axis=0 if rowwise else None)
-    gq2, sg2 = _quantize(g2, g_max, g_dtype, axis=0 if rowwise else None)
-    dw = jax.lax.dot_general(
-        xq, gq2, (((0,), (0,)), ((), ())),
-        preferred_element_type=acc).astype(jnp.float32)
-    if rowwise:
-        dw = dw * sx.reshape(-1, 1) * sg2.reshape(1, -1)
-    else:
-        dw = dw * sx * sg2
+    a_q, b_q = _gemm_dtypes(dtype, "a")
+    dx = quantized_matmul(g2, jnp.swapaxes(w, 0, 1), a_qdtype=a_q,
+                          b_qdtype=b_q, rowwise=rowwise)
+    dx = dx.reshape(x.shape)
+
+    # dw = x.T @ g  (contract over the batch rows; g is operand b)
+    x2 = x.reshape(-1, x.shape[-1])
+    a_q, b_q = _gemm_dtypes(dtype, "b")
+    dw = quantized_matmul(jnp.swapaxes(x2, 0, 1), g2, a_qdtype=a_q,
+                          b_qdtype=b_q, rowwise=rowwise)
     return dx.astype(x.dtype), dw.astype(w.dtype)
 
 
-qdot.defvjp(_qdot_fwd, _qdot_bwd)
+_qdot.defvjp(_qdot_fwd, _qdot_bwd)
 
 
 def maybe_qdot(x: jnp.ndarray, w: jnp.ndarray,
@@ -130,11 +259,34 @@ def maybe_qdot(x: jnp.ndarray, w: jnp.ndarray,
 
     Matmuls whose name matches ``filter_fqns`` (and any dim not divisible by
     16 — MXU tiling, same rule as torchao) stay high-precision."""
-    if cfg is None or not cfg.enabled:
-        return x @ w
-    if any(f in name for f in cfg.filter_fqns):
+    cfg = quant_for(cfg, name)
+    if cfg is None:
         return x @ w
     K, N = w.shape[-2], w.shape[-1]
     if K % 16 or N % 16:
         return x @ w
     return qdot(x, w, cfg.recipe_name, cfg.dtype)
+
+
+# ---------------------------------------------------------------------------
+# The qdot.xla rung: XLA-quantized operands through a plain dot_general —
+# the chain's always-available anchor AND the Pallas rung's parity oracle.
+# ---------------------------------------------------------------------------
+def _qdot_xla_impl(request, a, b, sa, sb):
+    a_q = jnp.dtype(request["a_dtype"])
+    b_q = jnp.dtype(request["b_dtype"])
+    aq = quant_cast(a, sa, a_q)
+    bq = quant_cast(b, sb, b_q)
+    out = jax.lax.dot_general(
+        aq, bq, (((1,), (0,)), ((), ())),
+        preferred_element_type=accum_dtype(a_q, b_q))
+    return out.astype(jnp.float32) * sa * sb
+
+
+def _qdot_xla_probe(request) -> bool:
+    return True
+
+
+registry.register_kernel(
+    "qdot.xla", probe=_qdot_xla_probe, impl=_qdot_xla_impl,
+    fallback=None, reference=_qdot_xla_impl)

@@ -103,12 +103,35 @@ def _block_plan(q_seq: int, kv_seq: int, *, causal: bool,
     return autotune.lookup("splash", fields, default, validate=_legal)
 
 
+def _bwd_block_plan(q_seq: int, kv_seq: int, *, causal: bool,
+                    local_window: Optional[int], dtype,
+                    fwd_blocks: Tuple[int, int, int]
+                    ) -> Tuple[int, int, int]:
+    """(block_q_dkv, block_kv_dkv, block_kv_dkv_compute) for the fused
+    backward.  Defaults to MIRRORING the forward triple (the pre-sweep
+    behavior, bit-identical with autotune off), but carries its own autotune
+    key ``"splash_bwd"`` — the dq/dkv pass has a different arithmetic
+    intensity (reads out/logsumexp residuals, writes three gradients) so
+    its sweet spot need not be the forward's (ROADMAP kernel follow-up)."""
+    fields = autotune.attention_sweep_key_fields(
+        {"q_seq": q_seq, "kv_seq": kv_seq, "dtype": str(dtype)},
+        causal=bool(causal), window=int(local_window or 0))
+
+    def _legal(c) -> bool:
+        return (len(c) == 3 and q_seq % c[0] == 0 and kv_seq % c[1] == 0
+                and c[1] % c[2] == 0 and c[2] >= _BLOCK)
+
+    return autotune.lookup("splash_bwd", fields, fwd_blocks,
+                           validate=_legal)
+
+
 @functools.lru_cache(maxsize=64)
 def _build_kernel(q_seq: int, kv_seq: int, q_heads_per_kv: int,
                   causal: bool, soft_cap: Optional[float],
                   interpret: bool = False,
                   local_window: Optional[int] = None,
-                  blocks: Optional[Tuple[int, int, int]] = None):
+                  blocks: Optional[Tuple[int, int, int]] = None,
+                  bwd_blocks: Optional[Tuple[int, int, int]] = None):
     """Mask processing runs host-side on numpy and is the expensive part —
     cache the built kernel per (shape, group, mask, blocks) signature.
 
@@ -136,10 +159,13 @@ def _build_kernel(q_seq: int, kv_seq: int, q_heads_per_kv: int,
     # Fused dq+dkv backward (one bwd pass instead of two) with kv-compute
     # sub-blocks at half the kv block: best of the measured grid on the
     # Llama-1B/v5e bench (~+6% step time vs plain 512 blocks + split bwd);
-    # block_*_dq are unused in fused mode.
+    # block_*_dq are unused in fused mode.  The backward triple mirrors the
+    # forward unless an autotuned "splash_bwd" winner overrides it
+    # (callers thread it via ``bwd_blocks``).
+    bq_d, bkv_d, bkvc_d = bwd_blocks if bwd_blocks is not None else blocks
     sizes = sk.BlockSizes(
         block_q=bq, block_kv=bkv, block_kv_compute=bkvc,
-        block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkvc,
+        block_q_dkv=bq_d, block_kv_dkv=bkv_d, block_kv_dkv_compute=bkvc_d,
         use_fused_bwd_kernel=True,
     )
     with jax.ensure_compile_time_eval():
@@ -208,12 +234,15 @@ def splash_attention_bshd(
     window = (None if local_window_size is None else int(local_window_size))
     blocks = _block_plan(S, Skv, causal=causal, local_window=window,
                          dtype=q.dtype)
+    bwd_blocks = _bwd_block_plan(S, Skv, causal=causal, local_window=window,
+                                 dtype=q.dtype, fwd_blocks=blocks)
     kernel = _build_kernel(S, Skv, G, causal,
                            None if logits_soft_cap is None
                            else float(logits_soft_cap),
                            interpret=_INTERPRET,
                            local_window=window,
-                           blocks=blocks)
+                           blocks=blocks,
+                           bwd_blocks=bwd_blocks)
 
     # The kernel has no sm_scale param: fold the scale into q.
     qs = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)
@@ -358,3 +387,10 @@ registry.register_kernel(
 autotune.register_sweep(
     "splash", key_fields=_sweep_key_fields, candidates=_sweep_candidates,
     run=_sweep_run)
+# The backward-specific triple (block_q_dkv / block_kv_dkv / *_compute)
+# sweeps independently: same key schema and candidate grid as the forward,
+# but _sweep_run's forced("splash_bwd", ...) only moves the fused dq/dkv
+# pass — the forward keeps its own plan, so the two winners compose.
+autotune.register_sweep(
+    "splash_bwd", key_fields=_sweep_key_fields,
+    candidates=_sweep_candidates, run=_sweep_run)

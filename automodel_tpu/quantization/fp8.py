@@ -13,9 +13,16 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 from typing import List, Optional
 
-from automodel_tpu.ops.quant import QuantConfig
+from automodel_tpu.ops.quant import (
+    QuantConfig,
+    normalize_quant_dtype,
+    normalize_quant_recipe,
+    validate_quant_dtype,
+    validate_quant_recipe,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -31,6 +38,15 @@ class FP8Config:
     enable_fsdp_float8_all_gather: bool = False
     precompute_float8_dynamic_scale_for_fsdp: bool = False
     force_recompute_fp8_weight_in_bwd: bool = False
+
+    def __post_init__(self):
+        # Same normalization + membership rule as config-load time
+        # (loader._enum_fields registers fp8.dtype / fp8.recipe_name), so a
+        # programmatic FP8Config cannot hold what a YAML would reject.
+        self.recipe_name = validate_quant_recipe(
+            normalize_quant_recipe(self.recipe_name))
+        self.dtype = validate_quant_dtype(
+            normalize_quant_dtype(self.dtype)) or "float8"
 
     def to_quant_config(self) -> QuantConfig:
         return QuantConfig(
@@ -50,22 +66,54 @@ def build_fp8_config(cfg=None, **kwargs) -> FP8Config:
     return FP8Config(**{k: v for k, v in kwargs.items() if k in fields})
 
 
-def apply_fp8_to_model(model, config: Optional[FP8Config] = None, **kwargs):
-    """Enable quantized compute on a functional model (sets ``model.quant``)."""
-    config = config or build_fp8_config(**kwargs)
+def _quant_targets(model) -> list:
+    """The module(s) whose matmuls consume a ``quant`` config: the model
+    itself, or — for VLM wrappers — the language tower (vision encoders
+    stay high-precision, the standard fp8-training scope).  Only objects
+    whose class DECLARES a ``quant`` attribute count: setting the attribute
+    on a model whose forward never reads it would silently no-op."""
     target = getattr(model, "base_model", model)   # through LoRA wrappers
+    if hasattr(target, "quant"):
+        return [target]
+    lm = getattr(target, "language_model", None)
+    if lm is not None and hasattr(lm, "quant"):
+        return [lm]
+    return []
+
+
+def apply_fp8_to_model(model, config: Optional[FP8Config] = None, **kwargs):
+    """Enable quantized compute on a functional model (sets ``quant`` on
+    every quant-capable target — the model, or a VLM's language tower).
+
+    A model family that ignores the knob entirely (no ``quant`` seam) warns
+    loudly — and raises under ``AUTOMODEL_STRICT_CONFIG=1`` — instead of
+    letting ``fp8.enabled: true`` silently train in bf16."""
+    config = config or build_fp8_config(**kwargs)
     if not config.enabled:
         return model
-    target.quant = config.to_quant_config()
-    logger.info("Quantized compute enabled: %s/%s",
-                config.dtype, config.recipe_name)
+    targets = _quant_targets(model)
+    if not targets:
+        msg = (f"fp8.enabled is set but model family "
+               f"{type(getattr(model, 'base_model', model)).__name__} has no "
+               "quantized-compute seam (no 'quant' attribute on the model or "
+               "its language tower) — the knob would silently no-op")
+        if os.environ.get("AUTOMODEL_STRICT_CONFIG") == "1":
+            raise ValueError(msg)
+        logger.warning("%s; TRAINING CONTINUES IN bf16", msg)
+        return model
+    for t in targets:
+        t.quant = config.to_quant_config()
+    logger.info("Quantized compute enabled: %s/%s on %s",
+                config.dtype, config.recipe_name,
+                ", ".join(type(t).__name__ for t in targets))
     return model
 
 
 def verify_fp8_conversion(model) -> dict:
     """Count quantizable matmuls (>=16-aligned dims), reference
     ``fp8.py:265``-style report."""
-    target = getattr(model, "base_model", model)
+    targets = _quant_targets(model)
+    target = targets[0] if targets else getattr(model, "base_model", model)
     quant = getattr(target, "quant", None)
     flat = []
 

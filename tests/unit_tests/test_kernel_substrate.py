@@ -40,6 +40,10 @@ def test_default_chains_are_registered():
         "gmm.pallas", "gmm.xla_blocked", "gmm.ragged"]
     assert registry.fallback_chain("linear_ce.pallas") == [
         "linear_ce.pallas", "linear_ce.chunked"]
+    assert registry.fallback_chain("qdot.pallas") == [
+        "qdot.pallas", "qdot.xla"]
+    assert registry.fallback_chain("gmm_quant.pallas") == [
+        "gmm_quant.pallas", "gmm_quant.xla_blocked", "gmm_quant.dense"]
 
 
 def test_resolve_walks_probes_in_chain_order():
@@ -295,11 +299,13 @@ def test_training_sweep_requests_cover_the_run():
     reqs = autotune.training_sweep_requests(_Model(), seq_len=512,
                                             local_batch=2)
     kernels = [k for k, _ in reqs]
-    assert kernels == ["splash", "linear_ce", "gmm", "gmm"]
+    # the fused backward's own triple sweeps under its own key (splash_bwd)
+    assert kernels == ["splash", "splash_bwd", "linear_ce", "gmm", "gmm"]
     # gmm plans the sorted dispatch's PADDED buffer rows (N + E*block): a
     # bare N would bucket one power of two short whenever N is a power of 2
     gmm_req = dict(reqs)["gmm"]
     assert gmm_req["m"] == 2 * 512 * 2 + 4 * 128
+    assert dict(reqs)["splash_bwd"] == dict(reqs)["splash"]
     # cp>1: dispatch resolves to the ring unconditionally, so the plan
     # sweeps the ring's PER-SHARD inner-tile key instead of splash
     cp_reqs = autotune.training_sweep_requests(_Model(), seq_len=512,
@@ -311,6 +317,62 @@ def test_training_sweep_requests_cover_the_run():
     assert autotune.training_sweep_requests(_Model(), seq_len=None) == []
     # unaligned seq -> nothing (kernels would decline those shapes anyway)
     assert autotune.training_sweep_requests(_Model(), seq_len=100) == []
+
+
+def test_training_sweep_requests_plan_qdot_under_quant():
+    """fp8.enabled models plan the quantized-matmul key (their dense GEMMs
+    route through qdot); quant off plans none."""
+    from automodel_tpu.ops.quant import QuantConfig
+
+    class _Cfg:
+        hidden_size = 256
+        intermediate_size = 512
+        num_attention_heads = 2
+        num_key_value_heads = 1
+        head_dim = 128
+        vocab_size = 512
+
+    class _Model:
+        config = _Cfg()
+
+    assert all(k != "qdot" for k, _ in
+               autotune.training_sweep_requests(_Model(), seq_len=512))
+    m = _Model()
+    m.quant = QuantConfig(enabled=True, dtype="int8",
+                          recipe_name="rowwise")
+    reqs = autotune.training_sweep_requests(m, seq_len=512, local_batch=2)
+    shapes = {(r["m"], r["k"], r["n"]) for k, r in reqs if k == "qdot"}
+    # ALL THREE GEMMs of a projection get a key: fwd (rows, K, N),
+    # dgrad (rows, N, K), wgrad (K, rows, N) — e.g. the gate/up [256, 512]
+    rows = 2 * 512
+    assert {(rows, 256, 512), (rows, 512, 256), (256, rows, 512)} <= shapes
+    # ... and the down / o_proj / kv projections are covered too
+    assert {(512, rows, 256), (rows, 256, 256), (256, rows, 256),
+            (rows, 256, 128), (256, rows, 128)} <= shapes
+    # keys are deduplicated by (m-bucket, k, n)
+    keyed = [(autotune.shape_bucket(r["m"]), r["k"], r["n"])
+             for k, r in reqs if k == "qdot"]
+    assert len(keyed) == len(set(keyed))
+    assert all(r["quant_dtype"] == "int8" and r["recipe"] == "rowwise"
+               for k, r in reqs if k == "qdot")
+
+
+def test_qdot_sweep_candidates_are_runtime_legal():
+    """A tn that does not divide n would run an EMPTY grid under forced()
+    (computes nothing, wins every timing) and be validate-rejected on
+    every real call — the candidate generator must filter it like the
+    budget (PR-7 persisted-then-rejected hardening class)."""
+    import automodel_tpu.ops.qdot_kernel as qk
+
+    cands = qk._sweep_candidates({"m": 1024, "k": 256, "n": 256})
+    assert cands
+    assert all(256 % tn == 0 for _, tn in cands)
+    assert (512, 512) not in cands
+    # and the budget filter still applies at large k
+    big = qk._sweep_candidates({"m": 4096, "k": 8192, "n": 512})
+    assert big and all(
+        qk._tile_bytes(tm, tn, 8192) <= 24 * 1024 * 1024
+        for tm, tn in big)
 
 
 def test_sweep_candidates_respect_the_runtime_budget():

@@ -86,9 +86,14 @@ def test_l002_flags_unregistered_enum_domain():
 
 
 def test_l002_registered_and_non_enum_constants_clean():
-    # CP_LAYOUTS / MOE_DISPATCHES are registered in loader._enum_fields
+    # CP_LAYOUTS / MOE_DISPATCHES / QUANT_* are registered in
+    # loader._enum_fields (the DTYPES/RECIPES suffixes joined the
+    # convention with the fp8.dtype / fp8.recipe_name fields)
     assert _lint('CP_LAYOUTS = ("contiguous", "zigzag")\n') == []
     assert _lint('MOE_DISPATCHES = ("sorted", "onehot")\n') == []
+    assert _lint('QUANT_DTYPES = ("float8", "int8")\n') == []
+    assert _rules(_lint('FOO_DTYPES = ("a", "b")\n')) == ["L002"]
+    assert _rules(_lint('BAR_RECIPES = ("a", "b")\n')) == ["L002"]
     # key lists / non-string tuples / short tuples are not enum domains
     assert _lint('_PACKED_KEYS = ("loss", "grad_norm")\n') == []
     assert _lint('FOO_MODES = (1, 2)\n') == []
