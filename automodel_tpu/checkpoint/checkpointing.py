@@ -111,6 +111,14 @@ class CheckpointingConfig:
     # inline save.  Bool-validated at config load (``config/loader.py``)
     # like ``distributed.cp_layout``; null means "use the default".
     async_save: bool = True
+    # Peer-to-peer in-memory replication (docs/guides/checkpointing.md
+    # "Peer replication"): after each ASYNC commit the committer pushes the
+    # host snapshot to a ring-neighbor slice's RAM-resident replica store
+    # so a later restore can skip storage (``checkpoint/replication.py``).
+    # One replica generation resident (bounded memory); no effect on
+    # inline saves or single-slice pools.  ``false`` disables the push —
+    # restores then always read storage.
+    replicate_to_peers: bool = True
 
     def __post_init__(self):
         if isinstance(self.model_save_format, CheckpointFormat):
@@ -138,6 +146,12 @@ class CheckpointingConfig:
             raise ValueError(
                 f"checkpoint.async_save must be a bool (or null for the "
                 f"default), got {self.async_save!r}")
+        if normalize_null_spelling(self.replicate_to_peers) is None:
+            self.replicate_to_peers = True
+        if not isinstance(self.replicate_to_peers, bool):
+            raise ValueError(
+                f"checkpoint.replicate_to_peers must be a bool (or null "
+                f"for the default), got {self.replicate_to_peers!r}")
 
 
 def build_checkpoint_config(cfg=None, **kwargs) -> CheckpointingConfig:

@@ -1,0 +1,449 @@
+"""Peer-to-peer in-memory checkpoint replication — the fast-restore layer.
+
+At 70B scale the dominant term in ``recovery_time_s`` is not the mesh
+rebuild, it is re-reading the full params/optimizer payload from blob
+storage on every recovery.  This module layers a RAM-resident replica on
+the PR-5 async committer so a recovery's restore is bounded by a
+neighbor's host-RAM bandwidth instead of storage latency:
+
+* **Push** — after every committed asynchronous save, each host serializes
+  its host-side snapshot (the numpy trees the committer already holds —
+  zero extra device traffic) and pushes the shard bytes into the
+  ring-neighbor slice's replica store (``slice (i+1) % n``), then
+  advertises a ``(step, shard -> sha256)`` catalog on the ``jax.distributed``
+  KV store and mirrors it to ``replica_catalog.p<idx>.json`` beside the
+  checkpoint dir for the operator (``tools/verify_checkpoint.py
+  --replicas``).  Memory is bounded: exactly ONE replica generation is
+  resident — a push drops the previous generation first, and the byte
+  buffers are shared between stores (immutable ``bytes``), so steady-state
+  cost is one snapshot-sized allocation.
+* **Restore** — ``BaseRecipe.load_checkpoint`` consults the catalog FIRST:
+  if a peer store holds the generation matching the checkpoint step being
+  restored, every shard is fetched and sha256-verified from RAM and the
+  storage read is skipped entirely (``restore_source=peer_ram``).  Any
+  miss, digest mismatch, structure mismatch, or injected fault falls back
+  to the storage path with a warning (``restore_source=storage``) —
+  restore CORRECTNESS never depends on replication, it is purely a
+  latency layer.
+* **Topology** — a lost slice's RAM died with it: ``drop_slice`` forgets
+  its store (the elastic ``reconfigure`` path calls it), which is exactly
+  why the push targets a ring NEIGHBOR — the replica of slice i's shards
+  lives on slice i+1, so one slice loss never takes both the primary and
+  its replica.  Pools with a single slice skip replication (no peer).
+
+Scope note (CPU container): stores are per-process objects.  On the
+single-process emulated-slice mesh every "slice RAM" lives in this
+process, so push/fetch exercise the full protocol (the drills and the
+elastic bench leg restore from peer RAM for real).  On a genuine
+multi-host pool the bulk shard transport between hosts' stores is not
+implemented here, so pushes advertise the catalog but keep NOTHING
+resident (a snapshot-sized generation no restore could read would be
+pure host-RAM cost) and restores read storage — the catalog/digest/
+fallback protocol is the piece the cross-host transport follow-up slots
+into (see ROADMAP).
+Replication never enters a jitted program and issues NO device
+collectives (pinned by the census test in
+``tests/unit_tests/test_replication.py``): all traffic is host RAM + KV
+RPCs.
+
+Fault points (``utils/fault_injection.py``): ``ckpt_replica_push`` (a push
+failure must never fail the already-committed save) and
+``ckpt_replica_restore`` (a corrupt/truncated shard mid-fetch must degrade
+to storage, silently correct).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from automodel_tpu.utils.fault_injection import fault_point
+
+logger = logging.getLogger(__name__)
+
+CATALOG_FILE_PREFIX = "replica_catalog"
+
+
+class ReplicaGeneration:
+    """One checkpoint generation resident in a slice's RAM: the shard map
+    ``key -> (digest, bytes, dtype, shape)`` plus its identity — the
+    (checkpoint path, step) pair.  The PATH is part of the identity so a
+    replica can never serve a restore of a different run's checkpoint that
+    happens to share a step number (several drills/runs share one
+    process on the emulated mesh)."""
+
+    def __init__(self, epoch: int, step: int,
+                 shards: Dict[str, Tuple[str, bytes, Any, Tuple[int, ...]]],
+                 ckpt_path: Optional[str] = None):
+        self.epoch = int(epoch)
+        self.step = int(step)
+        self.ckpt_path = (os.path.realpath(ckpt_path)
+                          if ckpt_path else None)
+        self.shards = shards
+
+    @property
+    def nbytes(self) -> int:
+        return sum(len(s[1]) for s in self.shards.values())
+
+
+class _StoreEntry:
+    """One slice's resident replica: the generation plus the DEVICE IDS of
+    the slice whose RAM this store models.  Device ids are the store's
+    durable identity — store KEYS are push-time slice indices, and a
+    shrink renumbers the survivors, so dropping by current index alone
+    would miss (or mis-hit) after stacked losses with no push between."""
+
+    def __init__(self, gen: ReplicaGeneration,
+                 devices: Optional[Tuple[int, ...]] = None):
+        self.gen = gen
+        self.devices = tuple(sorted(devices)) if devices else None
+
+
+# push-time-slice-id -> _StoreEntry: the per-process view of "each slice's
+# host RAM".  Guarded: pushes run on the async committer thread while
+# restores run on the training thread.
+_STORES: Dict[int, _StoreEntry] = {}
+_lock = threading.Lock()
+
+
+def reset() -> None:
+    """Forget every replica (tests / process teardown)."""
+    with _lock:
+        _STORES.clear()
+
+
+def drop_slice(slice_id: int, devices=None) -> None:
+    """A slice died: its RAM — and the replica generation it was holding —
+    is gone.  The elastic ``reconfigure`` path calls this on every slice
+    loss so a drill's restore can only succeed from a SURVIVOR's store,
+    exactly like the real pool.
+
+    ``devices`` (the lost slice's device ids) is the ROBUST identity and
+    what ``reconfigure`` passes: store keys are the slice indices of the
+    last PUSH's topology, and survivors renumber after a shrink, so after
+    stacked losses with no push in between the current index of the newly
+    dead slice need not equal its store key — any store whose recorded
+    device set intersects the dead devices is the dead slice's RAM.  The
+    bare-index form is the fallback for stores pushed without a mesh."""
+    dead = set(int(getattr(d, "id", d)) for d in devices) if devices else None
+    with _lock:
+        victims = [k for k, e in _STORES.items()
+                   if (dead is not None and e.devices is not None
+                       and dead & set(e.devices))
+                   or (e.devices is None or dead is None)
+                   and k == int(slice_id)]
+        for k in victims:
+            del _STORES[k]
+    if victims:
+        logger.info("replica store(s) %s of lost slice %d dropped",
+                    sorted(victims), slice_id)
+
+
+def stores_snapshot() -> Dict[int, Tuple[int, int, int]]:
+    """``{slice_id: (epoch, step, n_shards)}`` — introspection for tests
+    and the operator tool."""
+    with _lock:
+        return {s: (e.gen.epoch, e.gen.step, len(e.gen.shards))
+                for s, e in _STORES.items()}
+
+
+# ---------------------------------------------------------------------------
+# Tree <-> shard map
+# ---------------------------------------------------------------------------
+def _flatten_with_keys(tree: Any) -> List[Tuple[str, Any]]:
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def serialize_tree(tree: Any) -> Dict[str, Tuple[str, bytes, Any,
+                                                 Tuple[int, ...]]]:
+    """Numpy pytree -> shard map.  Keys are jax key-paths (stable for a
+    fixed tree structure); digests are sha256 over the raw contiguous
+    buffer, the integrity currency of the catalog."""
+    shards = {}
+    for key, leaf in _flatten_with_keys(tree):
+        # NOT ascontiguousarray: it silently promotes 0-d scalars to (1,),
+        # and tobytes() already emits C-order bytes for any layout
+        arr = np.asarray(leaf)
+        buf = arr.tobytes()
+        shards[key] = (hashlib.sha256(buf).hexdigest(), buf, arr.dtype,
+                       tuple(arr.shape))
+    return shards
+
+
+def _rebuild_tree(abstract: Any, shards: Dict[str, Tuple],
+                  verify: bool = True) -> Any:
+    """Shard map -> numpy pytree with ``abstract``'s structure.  Raises
+    ``KeyError`` on a missing shard and ``ValueError`` on a digest or
+    shape/dtype mismatch — the caller's per-shard fallback triggers."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(abstract)
+    leaves = []
+    for path, a in flat:
+        key = jax.tree_util.keystr(path)
+        # a truncated/corrupted buffer mid-fetch (the drill's shape)
+        fault_point("ckpt_replica_restore")
+        if key not in shards:
+            raise KeyError(f"replica shard {key!r} missing")
+        digest, buf, dtype, shape = shards[key]
+        if verify and hashlib.sha256(buf).hexdigest() != digest:
+            raise ValueError(f"replica shard {key!r} fails its sha256")
+        arr = np.frombuffer(buf, dtype=dtype).reshape(shape)
+        if (tuple(shape) != tuple(a.shape)
+                or np.dtype(dtype) != np.dtype(a.dtype)):
+            raise ValueError(
+                f"replica shard {key!r} is {dtype}{shape}, restore "
+                f"expects {a.dtype}{tuple(a.shape)}")
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(
+        treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# Push (async committer thread, AFTER the commit landed)
+# ---------------------------------------------------------------------------
+def _ring_targets(mesh_manager) -> List[Tuple[int, int]]:
+    """``(pushing_slice, target_slice)`` pairs for this process.  A real
+    multi-host pool pushes from each host to its slice's ring neighbor; the
+    single-process emulated mesh owns EVERY slice, so it performs all N
+    pushes (sharing the serialized buffers — bytes are immutable)."""
+    import jax
+
+    n = getattr(mesh_manager, "dcn_dp_size", 1) if mesh_manager else 1
+    if n < 2:
+        return []
+    if jax.process_count() == 1:
+        return [(s, (s + 1) % n) for s in range(n)]
+    my = jax.process_index()
+    for s in range(n):
+        if my in mesh_manager.slice_processes(s):
+            return [(s, (s + 1) % n)]
+    return []
+
+
+def push_replica(*, epoch: int, step: int, trees: Dict[str, Any],
+                 mesh_manager=None, checkpoint_dir: Optional[str] = None,
+                 ckpt_path: Optional[str] = None) -> bool:
+    """Replicate one committed generation into the ring-neighbor stores;
+    True iff anything was pushed.
+
+    Called by the async committer right after ``commit_checkpoint``
+    succeeded — the trees are the committer's existing host snapshot, so
+    the only cost is one serialize pass and the resident bytes.  A failure
+    here (including the armed ``ckpt_replica_push`` drill) must NEVER fail
+    the save: the caller wraps this, and this function itself only ever
+    raises out of the fault point / catastrophic serialization errors.
+    Pools without a peer slice (``dcn_dp < 2``) skip — there is no
+    neighbor RAM that survives losing this slice.
+    """
+    fault_point("ckpt_replica_push")
+    targets = _ring_targets(mesh_manager)
+    if not targets:
+        # No peer slice (dcn_dp < 2 or unknown mesh): nothing to push —
+        # but any RESIDENT generation is now both stale (training advanced
+        # past its step) and unrefreshable, so evict it rather than hold
+        # snapshot-sized bytes forever on the shrunk pool, and RETRACT
+        # this process's catalog advertisement so the operator tool does
+        # not report a replica that no longer exists.
+        with _lock:
+            evicted = bool(_STORES)
+            _STORES.clear()
+        if evicted:
+            logger.info(
+                "peer replication idle (no peer slice): dropping the "
+                "stale resident generation")
+            _retract_advertisement(checkpoint_dir)
+        logger.debug("peer replication skipped: no peer slice "
+                     "(dcn_dp < 2 or unknown mesh)")
+        return False
+    shards = serialize_tree(trees)
+    gen = ReplicaGeneration(epoch, step, shards, ckpt_path=ckpt_path)
+    import jax
+
+    if jax.process_count() > 1:
+        # Genuine multi-host pool: no bulk transport exists in this
+        # container, so keeping a snapshot-sized generation resident
+        # would pin tens of GB per host that NO restore can ever read
+        # (load_checkpoint's peer path bails multi-host).  Advertise the
+        # catalog — the digests the future cross-host transport and the
+        # operator tool need — and keep nothing resident.
+        with _lock:
+            _STORES.clear()
+        _advertise(epoch=epoch, step=step, shards=shards,
+                   checkpoint_dir=checkpoint_dir, ckpt_path=ckpt_path)
+        logger.info(
+            "checkpoint step %d replica catalog advertised (multi-host: "
+            "no resident peer store — cross-host transport is the "
+            "follow-up; restores read storage)", step)
+        return False
+    with _lock:
+        # single-generation memory bound: the previous generation —
+        # whatever store it sat in under the previous topology — is
+        # dropped before the new one becomes resident
+        _STORES.clear()
+        for _src, dst in targets:
+            try:
+                dev_ids = tuple(d.id for d in mesh_manager.slice_devices(dst))
+            except Exception:
+                dev_ids = None
+            _STORES[dst] = _StoreEntry(gen, devices=dev_ids)
+    logger.info(
+        "checkpoint step %d replicated to peer RAM (%d shard(s), %.1f MB, "
+        "ring targets %s)", step, len(shards), gen.nbytes / 1e6,
+        sorted({dst for _s, dst in targets}))
+    _advertise(epoch=epoch, step=step, shards=shards,
+               checkpoint_dir=checkpoint_dir, ckpt_path=ckpt_path)
+    return True
+
+
+def _advertise(*, epoch: int, step: int, shards: Dict[str, Tuple],
+               checkpoint_dir: Optional[str],
+               ckpt_path: Optional[str] = None) -> None:
+    """Publish the ``(step, shard -> digest)`` catalog: on the
+    ``jax.distributed`` KV store when a coordination client exists (the
+    restore-side agreement surface on a live pool), and mirrored to
+    ``replica_catalog.p<idx>.json`` beside the checkpoint dir so
+    ``tools/verify_checkpoint.py --replicas`` can report it offline.
+    Best-effort on both paths — advertising failures degrade the replica
+    to 'not found' at restore, never break the save."""
+    import jax
+
+    catalog = {
+        "epoch": int(epoch),
+        "step": int(step),
+        "ckpt_path": ckpt_path,
+        "process": jax.process_index(),
+        "shards": {k: {"sha256": v[0], "bytes": len(v[1]),
+                       "dtype": str(np.dtype(v[2])), "shape": list(v[3])}
+                   for k, v in shards.items()},
+    }
+    from automodel_tpu.utils.dist_utils import _kv_client, kv_set_overwrite
+
+    client = _kv_client()
+    if client is not None:
+        try:
+            # OVERWRITE: the key carries the NEWEST generation per host
+            # and must change every commit (the KV store is set-once by
+            # default).  Read side: the future cross-host transport and
+            # live-pool introspection; the operator tool reads the file
+            # mirror below offline.
+            kv_set_overwrite(
+                client,
+                f"ckpt_replica/catalog/p{jax.process_index()}",
+                json.dumps({"step": catalog["step"],
+                            "epoch": catalog["epoch"],
+                            "n_shards": len(shards)}))
+        except Exception as e:  # pragma: no cover - live-pool only
+            logger.warning("replica catalog KV advertise failed: %s", e)
+    if checkpoint_dir:
+        path = os.path.join(
+            checkpoint_dir,
+            f"{CATALOG_FILE_PREFIX}.p{jax.process_index()}.json")
+        try:
+            os.makedirs(checkpoint_dir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(catalog, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.warning("replica catalog mirror %s failed: %s", path, e)
+
+
+def _retract_advertisement(checkpoint_dir: Optional[str]) -> None:
+    """Best-effort removal of this process's catalog advertisement (file
+    mirror + KV key) after its replica generation was evicted — an
+    advertisement must never outlive the bytes it advertises."""
+    import jax
+
+    from automodel_tpu.utils.dist_utils import _kv_client
+
+    client = _kv_client()
+    if client is not None:
+        try:
+            client.key_value_delete(
+                f"ckpt_replica/catalog/p{jax.process_index()}")
+        except Exception:  # pragma: no cover - best-effort
+            pass
+    if checkpoint_dir:
+        path = os.path.join(
+            checkpoint_dir,
+            f"{CATALOG_FILE_PREFIX}.p{jax.process_index()}.json")
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
+def read_catalogs(checkpoint_dir: str) -> List[Dict[str, Any]]:
+    """Parsed ``replica_catalog.p*.json`` mirrors under a checkpoint root
+    (operator surface; [] when none)."""
+    out = []
+    if not os.path.isdir(checkpoint_dir):
+        return out
+    for name in sorted(os.listdir(checkpoint_dir)):
+        if (not name.startswith(CATALOG_FILE_PREFIX + ".")
+                or not name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(checkpoint_dir, name)) as f:
+                cat = json.load(f)
+            cat["_file"] = name
+            out.append(cat)
+        except (OSError, ValueError) as e:
+            logger.warning("unreadable replica catalog %s: %s", name, e)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Restore (training thread, inside BaseRecipe.load_checkpoint)
+# ---------------------------------------------------------------------------
+def restore_from_peers(*, step: int, abstract: Any,
+                       ckpt_path: Optional[str] = None) -> Optional[Any]:
+    """The peer-RAM restore attempt: a numpy pytree matching ``abstract``
+    (structure + shapes + dtypes) for checkpoint ``step``, or None when the
+    restore must take the storage path.
+
+    Every shard is digest-verified as it is fetched; ANY miss, mismatch, or
+    injected ``ckpt_replica_restore`` fault logs a warning naming the shard
+    and returns None — the caller falls back to the storage read for those
+    bytes (on this backend a full-tree storage restore; a byte-range
+    partial read is the 70B follow-up, see ROADMAP).  Multi-host: a shard
+    held in ANOTHER process's RAM is a miss here (no bulk transport in this
+    container) — the catalog is still consulted so the fallback is a
+    logged decision, not a silent one.
+    """
+    want_path = os.path.realpath(ckpt_path) if ckpt_path else None
+    with _lock:
+        candidates = [(s, e.gen) for s, e in _STORES.items()
+                      if e.gen.step == int(step)
+                      and (want_path is None or e.gen.ckpt_path is None
+                           or e.gen.ckpt_path == want_path)]
+    if not candidates:
+        logger.info(
+            "no peer RAM replica for checkpoint step %d (stores: %s) — "
+            "restoring from storage", step,
+            stores_snapshot() or "empty")
+        return None
+    slice_id, gen = min(candidates)
+    try:
+        tree = _rebuild_tree(abstract, gen.shards)
+    except Exception as e:
+        logger.warning(
+            "peer RAM replica of step %d (slice %d store) failed "
+            "verification mid-fetch (%s) — falling back to the storage "
+            "restore path", step, slice_id, e)
+        return None
+    logger.info(
+        "restored checkpoint step %d from slice %d's peer RAM replica "
+        "(%d shard(s), %.1f MB, digest-verified)", step, slice_id,
+        len(gen.shards), gen.nbytes / 1e6)
+    return tree

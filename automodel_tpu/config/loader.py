@@ -388,7 +388,7 @@ def _enum_normalizers():
 # re-checked after CLI overrides): a typo'd value must fail naming the field
 # before any recipe state is built from it.  YAML true/false and the CLI's
 # ``translate_value`` both produce real bools; anything else is a typo.
-_BOOL_FIELDS = ("checkpoint.async_save",)
+_BOOL_FIELDS = ("checkpoint.async_save", "checkpoint.replicate_to_peers")
 
 
 def normalize_null_spelling(v: Any) -> Any:

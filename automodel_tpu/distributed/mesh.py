@@ -274,11 +274,31 @@ class MeshManager:
         return tuple(sorted({d.process_index
                              for d in self._slice_devices[slice_id]}))
 
+    @property
+    def retired_slices(self) -> dict:
+        """``{retired_slice_token: (devices...)}`` — the slices a shrink
+        removed from this mesh lineage, remembered so a healed slice can be
+        re-admitted (``grow_slices``).  Tokens are the slice's id at the
+        time it was lost (bumped past live ids on collision, since
+        survivors renumber)."""
+        return {k: tuple(v)
+                for k, v in getattr(self, "_retired_slices", {}).items()}
+
+    def retired_slice_processes(self, token: int) -> Tuple[int, ...]:
+        """Host process indices of a RETIRED slice's devices (the set the
+        elastic detector requires to fully re-announce before a grow-back
+        probation streak counts)."""
+        devs = getattr(self, "_retired_slices", {})[token]
+        return tuple(sorted({d.process_index for d in devs}))
+
     def shrink_slices(self, lost_slice: int) -> "MeshManager":
         """The elastic-recovery mesh: same per-slice geometry, ``dcn_dp-1``
         slices, built over the SURVIVING slices' devices only.  Raises when
         there is no slice to lose (``dcn_dp == 1`` is the smallest mesh a
-        run can shrink to)."""
+        run can shrink to).  The lost slice's devices are REMEMBERED on the
+        shrunk manager (:attr:`retired_slices`) so a later
+        :meth:`grow_slices` can rebuild the full pool when the slice
+        heals."""
         n = self.dcn_dp_size
         if not 0 <= lost_slice < n:
             raise ValueError(
@@ -292,7 +312,7 @@ class MeshManager:
         for s in range(n):
             if s != lost_slice:
                 survivors.extend(self._slice_devices[s])
-        return MeshManager(
+        mm = MeshManager(
             dcn_dp_size=n - 1,
             dp_size=(n - 1) * self.dp_replicate_size * self.dp_shard_size,
             dp_replicate_size=self.dp_replicate_size,
@@ -303,6 +323,72 @@ class MeshManager:
             cp_layout=self.cp_layout,
             devices=survivors,
         )
+        retired = dict(getattr(self, "_retired_slices", {}))
+        token = lost_slice
+        while token in retired:  # stacked losses can reuse renumbered ids
+            token += n
+        retired[token] = list(self._slice_devices[lost_slice])
+        mm._retired_slices = retired
+        return mm
+
+    def grow_slices(self, returned_slice: Optional[int] = None,
+                    devices: Optional[Sequence[jax.Device]] = None
+                    ) -> "MeshManager":
+        """The grow-back mesh: inverse of :meth:`shrink_slices` — rebuild
+        at ``dcn_dp + 1`` with the returned slice's devices appended as the
+        LAST slice (survivors keep their ids, matching the loss-side
+        renumbering convention).
+
+        ``returned_slice`` names a retired-slice token
+        (:attr:`retired_slices`; default: the most recently retired one);
+        an explicit ``devices`` list admits a slice this lineage never saw
+        (a replacement slice standing in for the dead one) — it must match
+        the per-slice device count.  The grown manager forgets the admitted
+        token but keeps any OTHER retired slices (stacked losses heal one
+        at a time, each at its own checkpoint boundary)."""
+        retired = dict(getattr(self, "_retired_slices", {}))
+        if devices is None:
+            if not retired:
+                raise ValueError(
+                    "grow_slices: no retired slice to re-admit (this mesh "
+                    "lineage never shrank) — pass the returning slice's "
+                    "devices explicitly")
+            if returned_slice is None:
+                # most recently retired = LAST INSERTED (dict order);
+                # token values are not ordered by retirement time
+                returned_slice = next(reversed(retired))
+            if returned_slice not in retired:
+                raise ValueError(
+                    f"grow_slices: {returned_slice} is not a retired slice "
+                    f"(retired: {sorted(retired)})")
+            devices = retired.pop(returned_slice)
+        else:
+            devices = list(devices)
+            if returned_slice is not None:
+                retired.pop(returned_slice, None)
+        per_slice = len(self._slice_devices[0])
+        if len(devices) != per_slice:
+            raise ValueError(
+                f"grow_slices: returning slice has {len(devices)} devices, "
+                f"the pool's per-slice geometry needs {per_slice}")
+        n = self.dcn_dp_size
+        all_devices: List[jax.Device] = []
+        for s in range(n):
+            all_devices.extend(self._slice_devices[s])
+        all_devices.extend(devices)
+        mm = MeshManager(
+            dcn_dp_size=n + 1,
+            dp_size=(n + 1) * self.dp_replicate_size * self.dp_shard_size,
+            dp_replicate_size=self.dp_replicate_size,
+            tp_size=self.tp_size,
+            cp_size=self.cp_size,
+            sequence_parallel=self.sequence_parallel,
+            expert_parallel=self.expert_parallel,
+            cp_layout=self.cp_layout,
+            devices=all_devices,
+        )
+        mm._retired_slices = retired
+        return mm
 
     def __enter__(self):
         self._ctx = self.mesh

@@ -41,6 +41,7 @@ import copy
 import logging
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -152,7 +153,8 @@ class BaseRecipe:
 
     # -- elastic recovery ----------------------------------------------------
     def _rebuild_parallelism(self, mesh_manager) -> None:
-        """Rebuild plan + step functions for a NEW mesh (elastic shrink).
+        """Rebuild plan + step functions for a NEW mesh (elastic shrink or
+        grow-back).
 
         Recipes register ``self._parallelism_builder`` — a callable
         ``mesh_manager -> (plan, step_fns)`` capturing their model /
@@ -180,44 +182,79 @@ class BaseRecipe:
             abs_opt, fns.opt_state_sharding)
 
     def recover_from_slice_loss(self, event) -> Dict[str, Any]:
-        """Slice loss -> running again, with NO operator action:
+        """Slice loss -> running again, with NO operator action.  Thin
+        compatibility wrapper over :meth:`reconfigure` (an int ``event`` is
+        a bare lost-slice id)."""
+        from automodel_tpu.utils.elastic import SliceLostError
 
-        1. **Shrink**: rebuild the mesh at ``dcn_dp - 1`` over the surviving
-           slices' devices (``MeshManager.shrink_slices``) and rebuild the
-           plan/step functions on it (:meth:`_rebuild_parallelism`).
+        if not isinstance(event, SliceLostError):
+            event = SliceLostError(int(event), "caller-reported loss")
+        return self.reconfigure(event)
+
+    def reconfigure(self, event) -> Dict[str, Any]:
+        """The ONE topology-change path, shared by slice LOSS and slice
+        GAIN (grow-back):
+
+        1. **Resize**: rebuild the mesh — ``shrink_slices`` at
+           ``dcn_dp - 1`` for a :class:`~automodel_tpu.utils.elastic.
+           SliceLostError`, ``grow_slices`` at ``dcn_dp + 1`` for a
+           :class:`~automodel_tpu.utils.elastic.SliceReturnedError` (the
+           retired slice's devices were remembered by the shrink) — and
+           rebuild the plan/step functions on it
+           (:meth:`_rebuild_parallelism`).
         2. **Restore**: resume params/optimizer/host state from the last
-           COMMITTED checkpoint via the existing mesh-reshape restore path
-           (Orbax global arrays re-read against the new shardings).  An
-           in-flight background save is joined with its error demoted to a
-           log — its snapshot predates the failure and may never commit;
-           committed-ness remains the only currency.
+           COMMITTED checkpoint.  Peer RAM first: when the in-memory
+           replica a neighbor slice holds matches the checkpoint step, the
+           restore is a digest-verified RAM fetch instead of a storage
+           read (``checkpoint/replication.py``; ``restore_source`` in the
+           returned info says which path ran).  An in-flight background
+           save is joined with its error demoted to a log — its snapshot
+           predates the event and may never commit; committed-ness remains
+           the only currency.  A LOSS also drops the dead slice's replica
+           store (its RAM died with it).  Gain callers admit at a
+           commit boundary, so their restore loses zero steps.
         3. **Rescale**: apply the documented deterministic rule
-           (``utils/elastic.rescale_for_slice_loss``): grad-accumulation
-           steps multiply by ``old/gcd(old,new)`` so tokens-per-optimizer-
+           CHECKPOINT-regime -> new-topology
+           (``utils/elastic.rescale_between``): a shrink multiplies
+           grad-accumulation by ``old/gcd(old,new)``, a grow divides by
+           the same factor (the exact inverse), so tokens-per-optimizer-
            step — and therefore the LR schedule and per-token LR — are
-           unchanged whenever ``new`` divides ``old``; any residual batch
-           ratio folds into a linear LR scale, keeping per-token LR exact.
+           unchanged whenever the counts divide; any residual batch ratio
+           folds into a linear LR scale, keeping per-token LR exact.  A
+           shrink -> grow-back sequence therefore lands back on the
+           original hyperparameter regime.
 
         Wall time is charged to the ``elastic_rebuild`` timer (goodput
         accounting, ``training/timers.py``).  Returns a summary dict
-        ``{lost_slice, new_dcn_dp, restored_from, restored_step,
-        accum_factor, lr_scale}``.
+        ``{event, lost_slice | returned_slice, new_dcn_dp, restored_from,
+        restored_step, accum_factor, accum_divisor, lr_scale,
+        restore_source}``.
         """
+        from automodel_tpu.checkpoint import replication
         from automodel_tpu.utils.elastic import (
-            SliceLostError,
-            rescale_for_slice_loss,
+            SliceReturnedError,
+            rescale_between,
         )
 
-        lost = (event.slice_id if isinstance(event, SliceLostError)
-                else int(event))
+        gained = isinstance(event, SliceReturnedError)
         with self._record_timer("elastic_rebuild"):
-            # the in-flight snapshot predates the loss; never let its
+            # the in-flight snapshot predates the event; never let its
             # failure mask the recovery (committed state is the fallback)
             self.join_pending_save(raise_error=False)
             old_mm = self.mesh_manager
-            # shrink FIRST: a slice loss at dcn_dp=1 must surface the
-            # designed full-pool-loss error, not a rescale-domain ValueError
-            new_mm = old_mm.shrink_slices(lost)
+            if gained:
+                new_mm = old_mm.grow_slices(event.slice_id)
+            else:
+                # shrink FIRST: a slice loss at dcn_dp=1 must surface the
+                # designed full-pool-loss error, not a rescale-domain
+                # ValueError.  The dead slice's replica store dies with it
+                # — identified by its DEVICE IDS, not its current index
+                # (store keys are push-time indices; stacked losses with
+                # no push in between renumber past them).
+                lost_devs = [d.id
+                             for d in old_mm.slice_devices(event.slice_id)]
+                new_mm = old_mm.shrink_slices(event.slice_id)
+                replication.drop_slice(event.slice_id, devices=lost_devs)
             self.mesh_manager = new_mm
             self._rebuild_parallelism(new_mm)
             # shardings changed: re-probe async-save feasibility next save
@@ -225,57 +262,60 @@ class BaseRecipe:
             restored = self.load_checkpoint()
             if restored is None:
                 raise ckpt.CheckpointSaveError(
-                    f"slice {lost} lost but no committed checkpoint exists "
-                    "to resume from — enable checkpointing for elastic runs")
+                    f"slice {event.slice_id} "
+                    f"{'returned' if gained else 'lost'} but no committed "
+                    "checkpoint exists to resume from — enable "
+                    "checkpointing for elastic runs")
             # Rescale AFTER restore, from the regime the CHECKPOINT was
             # saved under (elastic_state rode the restore): the LR fields
             # just rewound to checkpoint values, so pairing them with a
-            # checkpoint-relative accumulation factor keeps the two
-            # consistent even when a SECOND slice loss lands before any
-            # new checkpoint — an incremental old-mesh-relative factor
-            # would compound across recoveries while the LR rewound.
+            # checkpoint-relative factor keeps the two consistent even when
+            # a SECOND topology change lands before any new checkpoint —
+            # an incremental old-mesh-relative factor would compound across
+            # recoveries while the LR rewound.
             es = getattr(self, "elastic_state", None)
             ckpt_slices = es.dcn_dp if es is not None else old_mm.dcn_dp_size
             sched = getattr(self, "step_scheduler", None)
             ckpt_accum = (es.grad_acc_steps if es is not None
                           else getattr(sched, "grad_acc_steps", 1))
-            if new_mm.dcn_dp_size < ckpt_slices:
-                rescale = rescale_for_slice_loss(
-                    ckpt_slices, new_mm.dcn_dp_size)
-            else:
-                # checkpoint already saved at (or below) the new width: the
-                # restored regime IS the target regime, identity rescale
-                from automodel_tpu.utils.elastic import Rescale
-
-                rescale = Rescale(old_slices=ckpt_slices,
-                                  new_slices=new_mm.dcn_dp_size)
+            rescale = rescale_between(ckpt_slices, new_mm.dcn_dp_size)
+            new_accum, residual_lr = rescale.target_accum(ckpt_accum)
             if sched is not None and hasattr(sched, "grad_acc_steps"):
-                sched.grad_acc_steps = ckpt_accum * rescale.accum_factor
+                sched.grad_acc_steps = new_accum
+            lr_scale = rescale.lr_scale * residual_lr
             lr_sched = getattr(self, "lr_scheduler", None)
-            if lr_sched is not None and rescale.lr_scale != 1.0:
+            if lr_sched is not None and lr_scale != 1.0:
                 for attr in ("init_lr", "max_lr", "min_lr"):
                     setattr(lr_sched, attr,
-                            getattr(lr_sched, attr) * rescale.lr_scale)
+                            getattr(lr_sched, attr) * lr_scale)
                 lr_sched.step(0)  # refresh current_lr under the new scale
             if es is not None:
-                # the NEXT checkpoint must record the post-recovery regime
+                # the NEXT checkpoint must record the post-event regime
                 es.dcn_dp = new_mm.dcn_dp_size
-                es.grad_acc_steps = getattr(sched, "grad_acc_steps",
-                                            es.grad_acc_steps)
+                es.grad_acc_steps = (new_accum if sched is None
+                                     else getattr(sched, "grad_acc_steps",
+                                                  new_accum))
+        restore_source = getattr(self, "_restore_source", "storage")
         info = {
-            "lost_slice": lost,
+            "event": "slice_gain" if gained else "slice_loss",
+            ("returned_slice" if gained else "lost_slice"): event.slice_id,
             "new_dcn_dp": new_mm.dcn_dp_size,
             "restored_from": restored,
             "restored_step": getattr(getattr(self, "step_scheduler", None),
                                      "step", None),
             "accum_factor": rescale.accum_factor,
-            "lr_scale": rescale.lr_scale,
+            "accum_divisor": rescale.accum_divisor,
+            "grad_acc_steps": new_accum,
+            "lr_scale": lr_scale,
+            "restore_source": restore_source,
         }
         logger.warning(
-            "elastic recovery: slice %d lost -> mesh rebuilt at dcn_dp=%d, "
-            "grad_acc x%d, lr x%.4g, resumed from %s",
-            lost, new_mm.dcn_dp_size, rescale.accum_factor, rescale.lr_scale,
-            restored)
+            "elastic %s: slice %d %s -> mesh rebuilt at dcn_dp=%d, "
+            "grad_acc %d -> %d, lr x%.4g, resumed from %s "
+            "(restore_source=%s)",
+            "grow-back" if gained else "recovery", event.slice_id,
+            "returned" if gained else "lost", new_mm.dcn_dp_size,
+            ckpt_accum, new_accum, lr_scale, restored, restore_source)
         return info
 
     # -- save ----------------------------------------------------------------
@@ -568,6 +608,26 @@ class BaseRecipe:
         ckpt.commit_checkpoint(path, final, epoch=job.epoch, step=job.step,
                                config=cfg, coordinator=coord)
         fault_point("ckpt_post_commit")
+        # Peer-to-peer in-memory replication (checkpoint/replication.py):
+        # the committer already holds the HOST snapshot, so pushing it to
+        # the ring-neighbor slice's RAM store costs one serialize pass and
+        # zero device traffic.  Strictly AFTER the commit (a replica may
+        # only ever advertise committed state) and guarded — the save has
+        # landed, a replication failure must never un-land it.
+        if job.is_async and cfg.replicate_to_peers and job.params is not None:
+            try:
+                from automodel_tpu.checkpoint import replication
+
+                replication.push_replica(
+                    epoch=job.epoch, step=job.step,
+                    trees={"params": job.params, "opt": job.opt_state},
+                    mesh_manager=getattr(self, "mesh_manager", None),
+                    checkpoint_dir=cfg.checkpoint_dir, ckpt_path=final)
+            except Exception:
+                logger.warning(
+                    "peer replica push for %s failed; the commit stands "
+                    "and the next restore takes the storage path",
+                    final, exc_info=True)
         if is_main:
             deleted = ckpt.gc_checkpoints(
                 cfg.checkpoint_dir, keep_last_k=cfg.keep_last_k,
@@ -612,8 +672,10 @@ class BaseRecipe:
         from automodel_tpu.utils.dist_utils import all_hosts_ok
 
         verr = None
+        manifest = None
         try:
-            ckpt.verify_manifest(path, deep=jax.process_index() == 0)
+            manifest = ckpt.verify_manifest(path,
+                                            deep=jax.process_index() == 0)
         except ckpt.CheckpointIntegrityError as e:
             verr = e
         if not all_hosts_ok(verr is None, "ckpt:verified"):
@@ -623,6 +685,17 @@ class BaseRecipe:
                 f"checkpoint {path} failed integrity verification on a "
                 "peer host")
 
+        # Peer-RAM fast restore (checkpoint/replication.py): when a
+        # neighbor slice's in-memory replica matches this checkpoint's
+        # step, the params/opt payload is fetched digest-verified from RAM
+        # and the storage read is skipped.  Any miss/corruption falls back
+        # to storage per shard set — restore correctness never depends on
+        # replication.  ``restore_source`` + the ckpt_restore_* timers
+        # record which path ran (bench/goodput surface).
+        t_restore0 = time.perf_counter()
+        object.__setattr__(self, "_restore_source", "storage")
+        peer = self._try_peer_restore(manifest, cfg, path)
+
         if getattr(self, "params", None) is not None:
             if getattr(self, "peft_config", None) is not None:
                 from automodel_tpu.peft.lora import load_adapters
@@ -630,6 +703,9 @@ class BaseRecipe:
                 self.params = load_adapters(
                     self.model, self.params, os.path.join(path, "model"),
                     shardings=getattr(self, "param_sharding", None))
+            elif peer is not None:
+                self.params = self._place_restored(
+                    peer["params"], getattr(self, "param_sharding", None))
             else:
                 self.params = ckpt.load_model(
                     self.model, os.path.join(path, "model"), cfg,
@@ -639,9 +715,32 @@ class BaseRecipe:
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                                sharding=getattr(x, "sharding", None)),
                 self.opt_state)
-            self.opt_state = ckpt.load_optimizer(
-                os.path.join(path, "optim"), abstract,
-                scheduler=getattr(self, "lr_scheduler", None), config=cfg)
+            if peer is not None:
+                self.opt_state = self._place_restored(peer["opt"], abstract)
+                # the LR scheduler stateful is tiny and storage-read even
+                # on the peer path (replicas carry only the array payload)
+                sched = getattr(self, "lr_scheduler", None)
+                if sched is not None and ckpt.has_stateful(
+                        os.path.join(path, "optim"), "lr_scheduler"):
+                    ckpt.load_stateful(os.path.join(path, "optim"),
+                                       "lr_scheduler", sched, cfg)
+            else:
+                self.opt_state = ckpt.load_optimizer(
+                    os.path.join(path, "optim"), abstract,
+                    scheduler=getattr(self, "lr_scheduler", None),
+                    config=cfg)
+        if peer is not None:
+            object.__setattr__(self, "_restore_source", "peer_ram")
+        timers = getattr(self, "timers", None)
+        if timers is not None:
+            timers(f"ckpt_restore_{self._restore_source}").add(
+                time.perf_counter() - t_restore0)
+        events = getattr(self, "_restore_events", None)
+        if events is None:
+            events = []
+            object.__setattr__(self, "_restore_events", events)
+        events.append((self._restore_source,
+                       time.perf_counter() - t_restore0))
         for key, obj in self._state_tracked.items():
             if key in ("lr_scheduler",) or isinstance(obj, ConfigNode):
                 continue
@@ -650,5 +749,58 @@ class BaseRecipe:
         # retention GC must never delete the checkpoint we resumed from
         # (it is the only committed state this run can fall back to)
         self._resumed_from = os.path.abspath(path)
-        logger.info("Restored checkpoint from %s", path)
+        logger.info("Restored checkpoint from %s (restore_source=%s)",
+                    path, getattr(self, "_restore_source", "storage"))
         return path
+
+    def _try_peer_restore(self, manifest, cfg,
+                          path: str) -> Optional[Dict[str, Any]]:
+        """The peer-RAM attempt of a restore: ``{"params": ..., "opt":
+        ...}`` numpy trees for the manifest's step, or None when the
+        storage path must run (no matching replica, PEFT adapters,
+        multi-host store locality, any verification failure).  Never
+        raises — replication is a latency layer, not a correctness
+        dependency."""
+        if (manifest is None or not getattr(cfg, "replicate_to_peers", True)
+                or getattr(self, "peft_config", None) is not None
+                or getattr(self, "params", None) is None):
+            return None
+        if jax.process_count() > 1:
+            # replica stores are per-process; a peer's RAM is not
+            # addressable from here (no bulk transport in this container —
+            # see checkpoint/replication.py scope note)
+            return None
+        try:
+            from automodel_tpu.checkpoint import replication
+
+            abstract = {
+                "params": jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    self.params),
+                "opt": (None if getattr(self, "opt_state", None) is None
+                        else jax.tree.map(
+                            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                            self.opt_state)),
+            }
+            return replication.restore_from_peers(
+                step=manifest["step"], abstract=abstract, ckpt_path=path)
+        except Exception:
+            logger.warning(
+                "peer-RAM restore attempt failed; falling back to the "
+                "storage path", exc_info=True)
+            return None
+
+    @staticmethod
+    def _place_restored(np_tree: Any, spec_tree: Any) -> Any:
+        """Place a peer-restored host tree onto devices.  ``spec_tree`` is
+        a matching tree of shardings OR of ``ShapeDtypeStruct``s whose
+        ``.sharding`` may be set (None -> default placement)."""
+        if spec_tree is None:
+            return jax.tree.map(jax.device_put, np_tree)
+
+        def place(leaf, spec):
+            sh = getattr(spec, "sharding", spec)
+            return (jax.device_put(leaf, sh) if sh is not None
+                    else jax.device_put(leaf))
+
+        return jax.tree.map(place, np_tree, spec_tree)

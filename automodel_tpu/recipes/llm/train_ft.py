@@ -854,6 +854,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         from automodel_tpu.utils.elastic import (
             ElasticCoordinator,
             SliceLostError,
+            SliceReturnedError,
         )
         from automodel_tpu.utils.sig_utils import (
             DistributedSignalHandler,
@@ -879,12 +880,42 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                     ElasticCoordinator(
                         self.mesh_manager,
                         heartbeat_timeout_s=ecfg.heartbeat_timeout_s,
-                        signal_handler=preempt)
+                        signal_handler=preempt,
+                        readmit_probation_polls=(
+                            ecfg.readmit_probation_polls))
                     if ecfg.enabled else None)
                 while True:
                     try:
                         self._train_epochs(sched, is_main, prof, preempt)
                         break
+                    except SliceReturnedError as e:
+                        # Grow-back: a retired slice passed probation and
+                        # was admitted at a committed-checkpoint boundary
+                        # (_post_step raised right after the commit landed,
+                        # so the restore below loses zero steps).  A healed
+                        # pool regains its FULL recovery headroom — healing
+                        # must not count against the shrink budget.
+                        logger.warning(
+                            "slice %d re-admitted at step %d: growing the "
+                            "mesh back", e.slice_id, e.detected_at_step)
+                        # a grow-back admitted MID-REPLAY: bank the partial
+                        # replay window first — reconfigure's wall time is
+                        # elastic_rebuild, and leaving the replay timer
+                        # running would double-count it in recovery_time_s
+                        replay_target = getattr(self, "_replay_until", None)
+                        if replay_target is not None:
+                            self.timers("elastic_replay").stop()
+                            self._replay_until = None
+                        self.reconfigure(e)
+                        self._post_slice_recovery()
+                        self._elastic.mesh_manager = self.mesh_manager
+                        recoveries = 0
+                        if (replay_target is not None
+                                and sched.step < replay_target):
+                            # steps between the admission checkpoint and
+                            # the original failure step are still replay
+                            self._replay_until = replay_target
+                            self.timers("elastic_replay").start()
                     except SliceLostError as e:
                         recoveries += 1
                         if (self._elastic is None
@@ -941,12 +972,12 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                 else "checkpointing disabled, nothing saved")
 
     def _post_slice_recovery(self):
-        """Recipe half of elastic recovery: rebuild the INPUT pipeline for
-        the shrunk mesh.  The rescale rule pins the per-device batch — the
-        global microbatch is ``local_batch_size x dp_size`` and ``dp_size``
-        just shrank — so the loader is rebuilt at the new width and resumed
-        from the restored sample index (state is a SAMPLE count, so it is
-        batch-size-independent)."""
+        """Recipe half of an elastic topology change (shrink OR grow-back):
+        rebuild the INPUT pipeline for the new mesh.  The rescale rule pins
+        the per-device batch — the global microbatch is ``local_batch_size
+        x dp_size`` and ``dp_size`` just changed — so the loader is rebuilt
+        at the new width and resumed from the restored sample index (state
+        is a SAMPLE count, so it is batch-size-independent)."""
         ss_cfg = self.cfg.get("step_scheduler")
         local_bs = int(ss_cfg.get("local_batch_size", 1)) if ss_cfg else 1
         old_loader = self.dataloader
@@ -1108,6 +1139,57 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             self.flush_metrics()
             self.save_checkpoint(epoch, step)
             self._last_ckpt_step = step
+            el = getattr(self, "_elastic", None)
+            pending = getattr(self, "_pending_readmit", None)
+            if el is not None and (pending is not None
+                                   or (jax.process_count() > 1
+                                       and el.mesh_manager.retired_slices)):
+                # Grow-back admission happens ONLY here, at a COMMITTED
+                # checkpoint boundary.  Three gates before the mesh grows:
+                # (1) REVALIDATE the latch — the slice may have flapped
+                #     since the poll that latched it (probation restarted);
+                #     growing back over a dead slice would trade a healthy
+                #     shrunk run for a broken full one;
+                # (2) multi-host: the UNANIMOUS agree_readmit vote —
+                #     per-host probation streaks can diverge by one poll,
+                #     and every survivor (latched or not) reaches this
+                #     boundary, so the vote is collective by construction;
+                # (3) the commit itself: join the async save so the grow
+                #     restores from it and zero steps are lost (a commit
+                #     failure surfaces like any other join point).
+                self._pending_readmit = None
+                # per-slice readiness, NOT ready_to_readmit() equality: a
+                # second retired slice finishing probation after the latch
+                # must not read as a flap of the first
+                candidate = (pending if pending is not None
+                             and el.is_ready(pending) else None)
+                if pending is not None and candidate is None:
+                    logger.warning(
+                        "re-admission of slice %d abandoned at step %d: "
+                        "its probation streak reset since it was latched "
+                        "(slice flapped); it re-qualifies after a fresh "
+                        "probation window", pending, step)
+                if jax.process_count() > 1:
+                    candidate = el.agree_readmit(candidate, step)
+                if candidate is not None:
+                    self.join_pending_save()
+                    from automodel_tpu.utils.dist_utils import (
+                        CollectiveTimeout,
+                    )
+
+                    try:
+                        event = el.admit(candidate, step)
+                    except CollectiveTimeout as e:
+                        # the returning hosts vanished inside the warm-up
+                        # window: abort THIS admission, keep training
+                        # shrunk — the pool is still healthy, and the
+                        # slice re-qualifies via a fresh probation window
+                        logger.warning(
+                            "re-admission of slice %d aborted at step %d: "
+                            "warm-up barrier timed out (%s); continuing "
+                            "on the shrunk mesh", candidate, step, e)
+                    else:
+                        raise event
         # Close the elastic replay window: once the run has re-reached the
         # step it died at, the re-trained steps stop counting as goodput
         # loss (timer opened by the recovery loop).
@@ -1183,6 +1265,27 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         if el is not None and step % max(
                 self.elastic_config.heartbeat_interval_steps, 1) == 0:
             el.poll(step)
+            # Grow-back: a retired slice that heartbeat through its full
+            # probation window becomes PENDING here; admission itself is
+            # deferred to the next committed-checkpoint boundary (the
+            # is_ckpt branch above) so the grow's restore loses no steps.
+            ready = el.ready_to_readmit()
+            if ready is not None and getattr(self, "_pending_readmit",
+                                             None) is None:
+                if self.checkpoint_config.enabled:
+                    logger.info(
+                        "retired slice %d passed probation at step %d; "
+                        "re-admitting at the next committed checkpoint "
+                        "boundary", ready, step)
+                    self._pending_readmit = ready
+                elif not getattr(self, "_warned_readmit_no_ckpt", False):
+                    self._warned_readmit_no_ckpt = True
+                    logger.warning(
+                        "retired slice %d is healthy again but "
+                        "checkpointing is disabled — grow-back needs a "
+                        "committed checkpoint to restore from; the run "
+                        "stays at dcn_dp=%d", ready,
+                        self.mesh_manager.dcn_dp_size)
         return False
 
     def _train_epochs(self, sched, is_main, prof, preempt=None):

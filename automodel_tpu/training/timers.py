@@ -91,6 +91,24 @@ def recovery_time_s(elapsed: Dict[str, float]) -> float:
     return sum(elapsed.get(name, 0.0) for name in ELASTIC_TIMERS)
 
 
+# Restore-path timers (recipes/base_recipe.py::load_checkpoint): every
+# checkpoint restore is credited to exactly one of these by its SOURCE —
+# ``ckpt_restore_peer_ram`` when the params/opt payload came out of a
+# neighbor slice's in-memory replica (checkpoint/replication.py),
+# ``ckpt_restore_storage`` when it was read from the checkpoint directory.
+# Restore time dominates ``recovery_time_s`` at 70B scale, and the peer
+# path exists to move it from blob-store latency to host-RAM bandwidth —
+# the split is the honest way to see whether it did.
+RESTORE_TIMERS = ("ckpt_restore_peer_ram", "ckpt_restore_storage")
+
+
+def restore_time_by_source(elapsed: Dict[str, float]) -> Dict[str, float]:
+    """``{"peer_ram": s, "storage": s}`` — the restore-latency split the
+    elastic bench secondary reports next to ``recovery_time_s``."""
+    return {name[len("ckpt_restore_"):]: elapsed.get(name, 0.0)
+            for name in RESTORE_TIMERS}
+
+
 @dataclasses.dataclass
 class ProfilingConfig:
     """``profiling:`` YAML section — wires :class:`Timers` into the hot loop.

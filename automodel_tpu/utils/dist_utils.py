@@ -46,6 +46,22 @@ def _kv_client():
         return None
 
 
+def kv_set_overwrite(client, key: str, value: str) -> None:
+    """``key_value_set`` that OVERWRITES: the coordination-service KV store
+    is set-once by default, so a key that must change over time (return
+    beats, admission offers, replica catalogs) needs ``allow_overwrite``
+    — with a delete-then-set fallback for clients that predate the
+    parameter."""
+    try:
+        client.key_value_set(key, value, allow_overwrite=True)
+    except TypeError:  # pragma: no cover - older coordination client
+        try:
+            client.key_value_delete(key)
+        except Exception:
+            pass
+        client.key_value_set(key, value)
+
+
 def _is_timeout_error(e: Exception) -> bool:
     """Whether a coordination-service error is a DEADLINE expiry (a dead
     peer) vs some other failure (tag reuse, connection loss, protocol

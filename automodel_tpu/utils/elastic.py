@@ -14,17 +14,27 @@ recoverable event:
   and the verdict is voted on the same KV domain so survivors can never
   split on who died.
 * :class:`SliceLostError` is the event: it names the lost slice and rides
-  the normal exception path up to ``BaseRecipe.recover_from_slice_loss``.
+  the normal exception path up to ``BaseRecipe.reconfigure``.
+* :class:`SliceReturnedError` is the HEALING event (grow-back): a slice the
+  pool previously shrank away re-appears, passes a probation window of
+  ``readmit_probation_polls`` consecutive healthy polls, and is admitted at
+  the next COMMITTED-checkpoint boundary (the recipe owns that gate — a
+  grow must restore from a checkpoint, so admitting anywhere else would
+  throw away the steps since the last commit).
 * :func:`rescale_for_slice_loss` is THE documented deterministic rescale
   rule (constant per-token LR via accumulation-step increase), pinned by
-  tier-1 tests — see the function docstring.
+  tier-1 tests — see the function docstring.  :func:`rescale_for_slice_gain`
+  is its exact inverse, so a shrink -> grow-back round trip lands on the
+  original hyperparameter regime.
 
-Drills: the ``slice_loss`` / ``elastic_heartbeat`` fault points
-(``utils/fault_injection.py``) make both failure shapes deterministic on
-the single-process CPU mesh with EMULATED slices — ``raise`` mode models
-surviving hosts detecting a dead peer slice (in-process shrink+resume),
-``:kill`` mode models being ON the dying slice (process vanishes
-mid-anything; the relaunch resumes from the last committed checkpoint).
+Drills: the ``slice_loss`` / ``elastic_heartbeat`` / ``elastic_readmit``
+fault points (``utils/fault_injection.py``) make the failure AND healing
+shapes deterministic on the single-process CPU mesh with EMULATED slices —
+``raise``-mode ``slice_loss`` models surviving hosts detecting a dead peer
+slice (in-process shrink+resume), ``raise``-mode ``elastic_readmit`` marks
+a retired slice's heartbeats as visible again (probation starts counting),
+``:kill`` modes model the process itself vanishing mid-anything (the
+relaunch resumes from the last committed checkpoint).
 """
 
 from __future__ import annotations
@@ -46,6 +56,9 @@ logger = logging.getLogger(__name__)
 # (default: the LAST slice — survivors keep the lowest slice ids, matching
 # how a real pool renumbers after a shrink).
 LOST_SLICE_ENV = "AUTOMODEL_LOST_SLICE"
+# Env override for which RETIRED slice a raise-mode ``elastic_readmit``
+# drill brings back (default: the most recently retired one).
+RETURNED_SLICE_ENV = "AUTOMODEL_RETURNED_SLICE"
 
 
 class SliceLostError(RuntimeError):
@@ -71,6 +84,23 @@ class SliceLostError(RuntimeError):
             + (" [this host's own slice]" if local else ""))
 
 
+class SliceReturnedError(RuntimeError):
+    """A previously-retired slice is healthy again and has been ADMITTED
+    (probation passed + warm-up barrier + a committed checkpoint boundary).
+    Not a failure — it rides the same exception path as
+    :class:`SliceLostError` so the recovery loop in the recipe can rebuild
+    mesh/plan/input pipeline in one place (``BaseRecipe.reconfigure``)."""
+
+    def __init__(self, slice_id: int, reason: str, detected_at_step: int = -1):
+        self.slice_id = slice_id
+        self.reason = reason
+        self.detected_at_step = detected_at_step
+        super().__init__(
+            f"slice {slice_id} returned ({reason})"
+            + (f" at step {detected_at_step}" if detected_at_step >= 0
+               else ""))
+
+
 @dataclasses.dataclass
 class ElasticConfig:
     """``elastic:`` YAML section.
@@ -82,12 +112,17 @@ class ElasticConfig:
           heartbeat_interval_steps: 10   # poll cadence (collective!)
           heartbeat_timeout_s: 60.0      # missed deadline => slice lost
           max_recoveries: 8              # then give up and re-raise
+          readmit_probation_polls: 3     # healthy polls before grow-back
     """
 
     enabled: bool = False
     heartbeat_interval_steps: int = 10
     heartbeat_timeout_s: float = 60.0
     max_recoveries: int = 8
+    # A returning slice must heartbeat through this many CONSECUTIVE
+    # healthy polls before it is eligible for re-admission (a flapping
+    # slice that dies again mid-probation restarts the count at zero).
+    readmit_probation_polls: int = 3
 
 
 def build_elastic_config(cfg=None) -> ElasticConfig:
@@ -135,14 +170,43 @@ class ElasticState:
 # ---------------------------------------------------------------------------
 @dataclasses.dataclass(frozen=True)
 class Rescale:
-    """How a run adapts to ``old_slices -> new_slices``: multiply the
-    grad-accumulation step count by ``accum_factor`` and every learning
-    rate by ``lr_scale``.  Exactly one of the two is != identity."""
+    """How a run adapts to ``old_slices -> new_slices``: the checkpoint's
+    grad-accumulation step count is multiplied by ``accum_factor`` and
+    divided by ``accum_divisor`` (shrinks multiply, grows divide — see
+    :meth:`target_accum`), and every learning rate is scaled by
+    ``lr_scale``.  ``lr_num``/``lr_den`` are the EXACT integer rational
+    behind ``lr_scale`` so a shrink -> grow round trip can be checked (and
+    composed) without float rounding: ``loss(a, b)`` then ``gain(b, a)``
+    compose to the identity rational by construction."""
 
     old_slices: int
     new_slices: int
     accum_factor: int = 1
+    accum_divisor: int = 1
     lr_scale: float = 1.0
+    lr_num: int = 1
+    lr_den: int = 1
+
+    def target_accum(self, ckpt_accum: int):
+        """Apply the accumulation half of the rule to the CHECKPOINT's
+        grad-accumulation count; ``(new_accum, residual_lr_scale)``.
+
+        Shrinks always divide cleanly (``accum_divisor == 1``).  A grow
+        divides by ``new/gcd`` — integral whenever the checkpoint regime
+        itself came from the matching shrink (the grow-back round trip).
+        When it is NOT integral (a grow to a topology the accumulation
+        never paid for, e.g. accum=1 at 2 slices growing to 3), the
+        nearest integral accumulation is used and the residual
+        tokens-per-step ratio folds into a linear LR scale — per-token LR
+        stays exactly constant either way, the same invariant as the
+        non-divisible shrink arm."""
+        num = int(ckpt_accum) * self.accum_factor
+        if num % self.accum_divisor == 0:
+            return num // self.accum_divisor, 1.0
+        new_accum = max(1, num // self.accum_divisor)
+        # tokens/step actually delivered vs the rule's target; lr follows
+        # linearly so lr-per-token is unchanged
+        return new_accum, new_accum * self.accum_divisor / num
 
 
 def rescale_for_slice_loss(old_slices: int, new_slices: int) -> Rescale:
@@ -179,17 +243,62 @@ def rescale_for_slice_loss(old_slices: int, new_slices: int) -> Rescale:
     """
     if old_slices < 1 or new_slices < 1 or new_slices >= old_slices:
         raise ValueError(
-            f"rescale needs 1 <= new_slices < old_slices, got "
-            f"{old_slices} -> {new_slices}")
+            f"rescale_for_slice_loss needs 1 <= new_slices < old_slices, "
+            f"got {old_slices} -> {new_slices} (for a slice GAIN — "
+            f"new_slices > old_slices, a healed pool growing back — use "
+            f"rescale_for_slice_gain; equal counts need no rescale)")
     import math
 
     g = math.gcd(old_slices, new_slices)
     accum_factor = old_slices // g
-    # tokens/step ratio after the accum increase: new * accum_factor / old
-    batch_ratio = new_slices * accum_factor / old_slices
-    lr_scale = batch_ratio  # == 1.0 whenever new divides old
+    # tokens/step ratio after the accum increase: new * accum_factor / old,
+    # which reduces exactly to the integer new // g
+    lr_num = new_slices // g
+    lr_scale = float(lr_num)  # == 1.0 whenever new divides old
     return Rescale(old_slices=old_slices, new_slices=new_slices,
-                   accum_factor=accum_factor, lr_scale=lr_scale)
+                   accum_factor=accum_factor, lr_scale=lr_scale,
+                   lr_num=lr_num, lr_den=1)
+
+
+def rescale_for_slice_gain(old_slices: int, new_slices: int) -> Rescale:
+    """The EXACT inverse of :func:`rescale_for_slice_loss` — the grow-back
+    rule (a retired slice returned and was re-admitted).
+
+    ``loss(a, b)`` multiplied accumulation by ``a // gcd(a, b)`` and LR by
+    ``b // gcd``; ``gain(b, a)`` divides accumulation by the same
+    ``a // gcd`` (see :meth:`Rescale.target_accum`) and scales LR by the
+    exact reciprocal ``gcd / b``, so a stacked shrink -> grow sequence
+    composes to the identity regime: same accumulation (integer
+    arithmetic, exact), same LR rational, same tokens/optimizer-step —
+    the recovered-and-healed run continues the ORIGINAL schedule.  Like
+    the shrink rule it is applied CHECKPOINT-regime -> new-topology
+    (``ElasticState``), never incrementally."""
+    if old_slices < 1 or new_slices <= old_slices:
+        raise ValueError(
+            f"rescale_for_slice_gain needs new_slices > old_slices >= 1, "
+            f"got {old_slices} -> {new_slices} (for a slice LOSS — "
+            f"new_slices < old_slices — use rescale_for_slice_loss; equal "
+            f"counts need no rescale)")
+    import math
+
+    g = math.gcd(old_slices, new_slices)
+    accum_divisor = new_slices // g
+    # exact reciprocal of the loss rule's lr ratio: g / old == 1/(old//g)
+    lr_den = old_slices // g
+    return Rescale(old_slices=old_slices, new_slices=new_slices,
+                   accum_factor=1, accum_divisor=accum_divisor,
+                   lr_scale=1.0 / lr_den, lr_num=1, lr_den=lr_den)
+
+
+def rescale_between(old_slices: int, new_slices: int) -> Rescale:
+    """Dispatch to the loss / gain rule (identity when equal) — the ONE
+    checkpoint-regime -> new-topology entry recovery uses for both event
+    kinds."""
+    if new_slices < old_slices:
+        return rescale_for_slice_loss(old_slices, new_slices)
+    if new_slices > old_slices:
+        return rescale_for_slice_gain(old_slices, new_slices)
+    return Rescale(old_slices=old_slices, new_slices=new_slices)
 
 
 def rescale_lr_only(old_slices: int, new_slices: int) -> Rescale:
@@ -198,10 +307,15 @@ def rescale_lr_only(old_slices: int, new_slices: int) -> Rescale:
     (``new/old``) so the per-token LR stays constant."""
     if old_slices < 1 or new_slices < 1 or new_slices >= old_slices:
         raise ValueError(
-            f"rescale needs 1 <= new_slices < old_slices, got "
-            f"{old_slices} -> {new_slices}")
+            f"rescale_lr_only needs 1 <= new_slices < old_slices, got "
+            f"{old_slices} -> {new_slices} (this is the shrink fallback "
+            f"arm; a slice gain goes through rescale_for_slice_gain)")
+    import math
+
+    g = math.gcd(old_slices, new_slices)
     return Rescale(old_slices=old_slices, new_slices=new_slices,
-                   accum_factor=1, lr_scale=new_slices / old_slices)
+                   accum_factor=1, lr_scale=new_slices / old_slices,
+                   lr_num=new_slices // g, lr_den=old_slices // g)
 
 
 # ---------------------------------------------------------------------------
@@ -231,20 +345,40 @@ class ElasticCoordinator:
     COLLECTIVE: every host must call it on the same steps (the recipe
     polls on a fixed step cadence); the previous poll's keys are GC'd by
     process 0 each round.
+
+    Grow-back (ISSUE 11): after a shrink the mesh remembers the retired
+    slice's devices (``MeshManager.retired_slices``).  Each poll also
+    notes which retired slices are heartbeating again — via the
+    ``elastic_readmit`` drill fault point single-process, via
+    ``<ns>/return/<slice>/p<idx>`` KV keys the returning hosts publish
+    (:meth:`announce_return`) multi-process — and counts a PROBATION
+    streak per slice (``readmit_probation_polls`` consecutive healthy
+    polls; a gap resets the streak).  :meth:`ready_to_readmit` exposes the
+    verdict; the recipe ADMITS only at a committed-checkpoint boundary by
+    calling :meth:`admit`, which takes the warm-up barrier with the
+    returning hosts and returns the typed :class:`SliceReturnedError`
+    event for the shared ``reconfigure`` path.
     """
 
     def __init__(self, mesh_manager, *,
                  heartbeat_timeout_s: float = 60.0,
                  signal_handler=None,
-                 namespace: Optional[CollectiveNamespace] = None):
+                 namespace: Optional[CollectiveNamespace] = None,
+                 readmit_probation_polls: int = 3):
         self.mesh_manager = mesh_manager
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.signal_handler = signal_handler
         self.namespace = namespace or CollectiveNamespace("elastic")
+        self.readmit_probation_polls = max(int(readmit_probation_polls), 1)
         self._poll_seq = 0
         self.last_poll_t: Optional[float] = None
         self.prev_poll_t: Optional[float] = None
         self._last_hb_key: Optional[str] = None
+        # grow-back state: retired-slice id -> consecutive healthy polls,
+        # plus the set of retired slices whose return the drill fault (or
+        # KV announcements) made visible
+        self._probation: dict = {}
+        self._returned_visible: set = set()
 
     # -- topology ----------------------------------------------------------
     @property
@@ -263,6 +397,16 @@ class ElasticCoordinator:
             return int(env)
         return self.num_slices - 1
 
+    def _drilled_returned_slice(self, retired) -> int:
+        env = os.environ.get(RETURNED_SLICE_ENV)
+        if env is not None:
+            return int(env)
+        # the most recently retired slice: retirement order is the
+        # INSERTION order of the retired dict (token values are not
+        # ordered by time — an early loss of a high slice id outranks a
+        # later loss of a low one under max())
+        return list(retired)[-1]
+
     # -- the poll ----------------------------------------------------------
     def poll(self, step: int = -1) -> None:
         """Collective health check; raises :class:`SliceLostError` when a
@@ -275,6 +419,10 @@ class ElasticCoordinator:
         # "host vanishes mid-async-commit" arms the hit count so the
         # background committer is still writing when the process exits).
         fault_point("elastic_heartbeat")
+        # Grow-back bookkeeping first: a returning slice's probation streak
+        # must advance on the same healthy polls the loss verdict below
+        # reads (never raises a slice verdict itself).
+        self._note_returning(step)
         # Verdict fault point: raise-mode drills model the SURVIVORS'
         # view — a peer slice stopped answering.
         try:
@@ -408,3 +556,350 @@ class ElasticCoordinator:
         if self.prev_poll_t is None or self.last_poll_t is None:
             return 0.0
         return max(0.0, self.last_poll_t - self.prev_poll_t)
+
+    # -- grow-back: probation + admission -----------------------------------
+    def announce_return(self, slice_id: int) -> None:
+        """Called BY A RETURNING HOST (relaunch on a healed slice joining
+        an elastic pool): publish a FRESH heartbeat value on the elastic KV
+        namespace.  Call it REPEATEDLY — well within every
+        ``heartbeat_timeout_s`` — until admitted: survivors count a
+        probation poll only while every one of the slice's hosts' beats
+        keeps changing inside that window, so a stale announcement left
+        behind by a slice that flapped (KV keys outlive their writer) ages
+        out of probation instead of serving it forever.  Harmless no-op
+        without a coordination client (single-process drills use the
+        ``elastic_readmit`` fault point instead)."""
+        client = self.namespace._client()
+        if client is None:
+            return
+        from automodel_tpu.utils.dist_utils import kv_set_overwrite
+
+        try:
+            # OVERWRITE semantics are load-bearing: the KV store is
+            # set-once by default, and a beat that cannot change would
+            # read as stale after one freshness window
+            kv_set_overwrite(
+                client,
+                f"{self.namespace.name}/return/{int(slice_id)}"
+                f"/p{jax.process_index()}", str(time.monotonic_ns()))
+        except Exception as e:  # pragma: no cover - best-effort announce
+            logger.warning("announce_return(%d) failed: %s", slice_id, e)
+
+    def _kv_returning(self, retired) -> set:
+        """Retired slices whose EVERY process has a FRESH return beat.
+        Partial re-appearance (some hosts of the slice still down) does
+        not count, and neither does a latched stale announcement: a beat
+        is fresh while it keeps CHANGING — each observed change stamps a
+        local clock, and a beat whose value has not moved for
+        ``heartbeat_timeout_s`` is stale (the KV keys are only GC'd at
+        admission, so a flapped slice's last writes would otherwise keep
+        its probation streak alive forever).  The window — rather than
+        advanced-every-poll — tolerates a poll cadence faster than the
+        returning hosts' announce cadence."""
+        client = self.namespace._client()
+        if client is None:
+            return set()
+        out = set()
+        seen = getattr(self, "_return_beat_seen", None)
+        if seen is None:
+            seen = self._return_beat_seen = {}
+        now = time.monotonic()
+        for s in retired:
+            try:
+                keys = dict(client.key_value_dir_get(
+                    f"{self.namespace.name}/return/{s}/"))
+            except Exception:
+                continue
+            beats = {k.rsplit("/", 1)[-1]: v for k, v in keys.items()}
+            procs = {f"p{p}" for p in
+                     self.mesh_manager.retired_slice_processes(s)}
+            if not procs or not procs <= set(beats):
+                continue
+            fresh = True
+            for p in procs:
+                prev = seen.get((s, p))
+                if prev is None or prev[0] != beats[p]:
+                    seen[(s, p)] = (beats[p], now)
+                elif now - prev[1] > self.heartbeat_timeout_s:
+                    fresh = False
+            if fresh:
+                out.add(s)
+        return out
+
+    def _note_returning(self, step: int) -> None:
+        """Advance the probation streak of every retired slice that is
+        heartbeating again this poll; a slice absent this poll restarts at
+        zero (flapping never shortens probation).  Never raises a verdict —
+        :meth:`ready_to_readmit` exposes the result and the RECIPE admits
+        at a committed-checkpoint boundary."""
+        retired = getattr(self.mesh_manager, "retired_slices", {})
+        if not retired:
+            self._probation.clear()
+            self._returned_visible.clear()
+            return
+        # Drill hook: raise-mode marks the drilled retired slice's
+        # heartbeats as visible from this poll onward (the slice came back
+        # up and STAYED up); ``:kill`` here is this host dying while
+        # tracking a re-admission.
+        try:
+            fault_point("elastic_readmit")
+        except InjectedFault as e:
+            sid = self._drilled_returned_slice(retired)
+            self._returned_visible.add(sid)
+            logger.info(
+                "elastic_readmit drill: retired slice %d heartbeats "
+                "visible again (%s)", sid, e)
+        visible = self._returned_visible & set(retired)
+        if jax.process_count() > 1:
+            visible = visible | self._kv_returning(retired)
+        for s in list(self._probation):
+            if s not in visible:
+                del self._probation[s]  # streak broken: restart probation
+        for s in visible:
+            self._probation[s] = self._probation.get(s, 0) + 1
+
+    def ready_to_readmit(self) -> Optional[int]:
+        """The lowest retired slice whose probation streak has reached
+        ``readmit_probation_polls``, or None.  This is each host's LOCAL
+        view (KV reads are not atomic across hosts, so streaks can differ
+        by one poll between survivors) — multi-host admission therefore
+        goes through the unanimous :meth:`agree_readmit` vote at the
+        checkpoint boundary before anyone enters the warm-up barrier."""
+        for s in sorted(self._probation):
+            if self._probation[s] >= self.readmit_probation_polls:
+                return s
+        return None
+
+    def is_ready(self, slice_id: int) -> bool:
+        """Whether ONE specific slice's probation streak is served —
+        the boundary revalidation check for a latched admission.  (NOT
+        ``ready_to_readmit() == slice_id``: that compares against the
+        global LOWEST ready slice, which wrongly reads as a flap whenever
+        a second, lower-token retired slice finishes probation after the
+        latch.)"""
+        return (self._probation.get(slice_id, 0)
+                >= self.readmit_probation_polls)
+
+    def _survivor_process_ids(self) -> list:
+        """Host process indices of the CURRENT (shrunk) mesh — the
+        participant set of survivor-only barriers.  A whole-job barrier
+        would wait forever on the retired slices' processes."""
+        procs: set = set()
+        for s in range(self.num_slices):
+            procs.update(self.mesh_manager.slice_processes(s))
+        return sorted(procs)
+
+    @staticmethod
+    def _wait_barrier(client, key: str, timeout_ms: int,
+                      process_ids) -> None:
+        """Bounded barrier over an EXPLICIT participant set; degrades to
+        the whole-job barrier on coordination clients that predate
+        ``process_ids`` (logged — on such clients survivor-only barriers
+        can only time out, which reads as 'not this boundary')."""
+        try:
+            client.wait_at_barrier(key, timeout_ms,
+                                   process_ids=list(process_ids))
+        except TypeError:
+            logger.warning(
+                "coordination client lacks process_ids barriers; %s "
+                "degrades to a whole-job barrier", key)
+            client.wait_at_barrier(key, timeout_ms)
+
+    def agree_readmit(self, candidate: Optional[int],
+                      step: int) -> Optional[int]:
+        """COLLECTIVE readmission agreement — every SURVIVOR must call it
+        at the same checkpoint boundary (the recipe calls it at every
+        boundary on multi-host elastic runs, pending or not).  Each host
+        publishes the slice IT believes is ready (or none); admission
+        proceeds only when the pool UNANIMOUSLY names the same slice —
+        per-host probation streaks can diverge by one poll (non-atomic KV
+        reads), and without this round one survivor would enter the
+        warm-up barrier while its peers dispatch the next train step's
+        device collectives, hanging the pool.  Any disagreement or a
+        missed deadline just means "not this boundary": the latch drops
+        and a later boundary retries.  Single-process: the local verdict
+        IS the pool's."""
+        if jax.process_count() <= 1:
+            return candidate
+        client = self.namespace._client()
+        if client is None:
+            logger.warning(
+                "agree_readmit: no coordination client; skipping "
+                "re-admission this boundary")
+            return None
+        from automodel_tpu.utils.dist_utils import _is_timeout_error
+
+        key = f"{self.namespace.name}/readmit_vote/{int(step)}"
+        client.key_value_set(
+            f"{key}/p{jax.process_index()}",
+            str(candidate if candidate is not None else -1))
+        try:
+            # SURVIVOR-ONLY barrier: the returning hosts are not part of
+            # this vote (they sit in wait_for_admission until the offer),
+            # so a whole-job barrier would deadlock against them
+            self._wait_barrier(client, key + ".in",
+                               int(self.heartbeat_timeout_s * 1000),
+                               self._survivor_process_ids())
+        except Exception as e:
+            if not _is_timeout_error(e):
+                raise
+            # a survivor missed the vote deadline: no admission now (the
+            # NEXT health poll decides whether that survivor is dead)
+            return None
+        votes = {}
+        for k, v in client.key_value_dir_get(f"{key}/"):
+            try:
+                votes[int(k.rsplit("p", 1)[1])] = v
+            except (ValueError, IndexError):  # pragma: no cover
+                continue
+        # GC the previous boundary's vote keys (same pattern as the
+        # heartbeat GC: owner = the lowest process THAT VOTED, so GC
+        # survives losing slice 0)
+        prev = getattr(self, "_last_readmit_vote_key", None)
+        self._last_readmit_vote_key = key
+        if prev is not None and votes and jax.process_index() == min(votes):
+            try:
+                client.key_value_delete(f"{prev}/")
+            except Exception:  # pragma: no cover - best-effort GC
+                pass
+        vals = list(votes.values())
+        if vals and all(v == vals[0] for v in vals) and vals[0] != "-1":
+            return int(vals[0])
+        return None
+
+    def _warmup_barrier_key(self, slice_id: int, step: int) -> str:
+        """The admission warm-up barrier tag.  Keyed by (slice, admission
+        step) — values every survivor shares at a collective boundary and
+        the returning hosts learn from the offer key — never by a
+        per-host counter, which would desync after any partially-observed
+        abort."""
+        return (f"{self.namespace.name}/readmit/s{int(slice_id)}"
+                f"/step{int(step)}.warmup")
+
+    def admit(self, slice_id: int, step: int = -1) -> SliceReturnedError:
+        """Admit an agreed slice: publish the admission OFFER (telling the
+        returning hosts, blocked in :meth:`wait_for_admission`, which
+        warm-up barrier to join), take that barrier with them, clear the
+        probation state, and return the typed event for
+        ``BaseRecipe.reconfigure``.  The CALLER owns the commit-boundary
+        gate and (multi-host) the :meth:`agree_readmit` unanimity vote —
+        this must only run right after a checkpoint commit landed, so the
+        grow-back restore loses zero steps."""
+        client = self.namespace._client()
+        if client is not None and jax.process_count() > 1:
+            import json as _json
+
+            timeout_ms = int(self.heartbeat_timeout_s * 1000)
+            offer = f"{self.namespace.name}/readmit_offer/s{int(slice_id)}"
+            key = self._warmup_barrier_key(slice_id, step)
+            # warm-up participants: every SURVIVOR plus the returning
+            # slice's hosts — shipped in the offer so the returning side
+            # (whose topology knowledge is stale) passes the identical
+            # process set to the barrier
+            procs = sorted(set(self._survivor_process_ids())
+                           | set(self.mesh_manager
+                                 .retired_slice_processes(slice_id)))
+            from automodel_tpu.utils.dist_utils import kv_set_overwrite
+
+            try:
+                # OVERWRITE: a later admission attempt must replace a
+                # previous (aborted) attempt's offer, never be silently
+                # swallowed by the set-once store while survivors wait at
+                # a barrier the returning hosts cannot find
+                kv_set_overwrite(
+                    client, offer,
+                    _json.dumps({"step": int(step), "procs": procs}))
+            except Exception as e:  # pragma: no cover - best-effort offer
+                logger.warning("admission offer for slice %d failed: %s",
+                               slice_id, e)
+            try:
+                self._wait_barrier(client, key, timeout_ms, procs)
+            except Exception as e:
+                from automodel_tpu.utils.dist_utils import _is_timeout_error
+
+                if not _is_timeout_error(e):
+                    raise
+                # the returning hosts vanished again inside the warm-up
+                # window: abort the admission, probation restarts — and
+                # retract the offer so a later relaunch cannot target
+                # this attempt's dead barrier
+                try:
+                    client.key_value_delete(offer)
+                except Exception:  # pragma: no cover - best-effort GC
+                    pass
+                self._probation.pop(slice_id, None)
+                self._returned_visible.discard(slice_id)
+                raise CollectiveTimeout(key, self.heartbeat_timeout_s,
+                                        str(e)) from e
+            # GC this slice's return announcements + offer — consumed
+            for stale in (f"{self.namespace.name}/return/{int(slice_id)}/",
+                          offer):
+                try:
+                    client.key_value_delete(stale)
+                except Exception:  # pragma: no cover - best-effort GC
+                    pass
+        self._probation.pop(slice_id, None)
+        self._returned_visible.discard(slice_id)
+        return SliceReturnedError(
+            slice_id,
+            f"passed probation ({self.readmit_probation_polls} healthy "
+            "polls) and a committed-checkpoint boundary", step)
+
+    def wait_for_admission(self, slice_id: int, *,
+                           announce_interval_s: float = 5.0,
+                           timeout_s: float = 3600.0) -> int:
+        """The RETURNING HOSTS' half of the handshake (relaunch entry on a
+        healed slice): announce fresh return beats on a cadence until the
+        survivors publish the admission offer, then join the step-keyed
+        warm-up barrier with them; returns the admission step (the
+        checkpoint the grown pool restarts from).  Raises
+        :class:`CollectiveTimeout` when no offer lands inside
+        ``timeout_s`` (the pool may have chosen to keep running shrunk).
+        Single-process drills never call this — the ``elastic_readmit``
+        fault point stands in for the announcements."""
+        client = self.namespace._client()
+        if client is None or jax.process_count() <= 1:
+            return -1
+        from automodel_tpu.utils.dist_utils import _is_timeout_error
+
+        import json as _json
+
+        offer = f"{self.namespace.name}/readmit_offer/s{int(slice_id)}"
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            self.announce_return(slice_id)
+            try:
+                val = client.blocking_key_value_get(
+                    offer, int(announce_interval_s * 1000))
+            except Exception as e:
+                if _is_timeout_error(e):
+                    continue  # keep announcing until the offer lands
+                raise
+            parsed = _json.loads(val)
+            step = int(parsed["step"])
+            # the offer names the exact barrier participant set (survivors
+            # + this slice's hosts) — this host's own topology knowledge
+            # is stale by definition
+            try:
+                self._wait_barrier(client,
+                                   self._warmup_barrier_key(slice_id, step),
+                                   int(self.heartbeat_timeout_s * 1000),
+                                   parsed["procs"])
+            except Exception as e:
+                if not _is_timeout_error(e):
+                    raise
+                # a STALE offer (an admission attempt that aborted before
+                # its retraction landed, or that this host joined too
+                # late): drop it and go back to announcing — the next
+                # boundary publishes a fresh offer
+                logger.warning(
+                    "warm-up barrier for stale admission offer (step %d) "
+                    "timed out; re-announcing", step)
+                try:
+                    client.key_value_delete(offer)
+                except Exception:  # pragma: no cover - best-effort GC
+                    pass
+                continue
+            return step
+        raise CollectiveTimeout(offer, timeout_s,
+                                "no admission offer from the survivors")

@@ -79,6 +79,31 @@ Elastic multi-slice points (see ``utils/elastic.py``):
                       restore); ``:kill`` hard-exits, modelling the hosts
                       of the lost slice vanishing (recovery = relaunch at
                       dcn_dp-1 resuming from the last committed step).
+    elastic_readmit   in ``ElasticCoordinator._note_returning`` (each poll
+                      while any slice is retired) — ``raise`` mode marks
+                      the drilled RETIRED slice's heartbeats as visible
+                      again, starting its probation streak (the grow-back
+                      drill's trigger; the contract is probation +
+                      admission at the next committed-checkpoint
+                      boundary); ``:kill`` is this host dying while
+                      tracking a re-admission — the pool stays shrunk and
+                      the relaunch resumes from the last committed step.
+
+Checkpoint-replication points (see ``checkpoint/replication.py``):
+
+    ckpt_replica_push on the async COMMITTER thread at the top of the
+                      peer-replica push, strictly AFTER the commit landed
+                      — ``raise`` mode contract: the save STANDS, the
+                      push is skipped with a warning, and the next
+                      restore takes the storage path; ``:kill`` models a
+                      host dying right after its commit (relaunch resumes
+                      from that committed step, replica store empty).
+    ckpt_replica_restore
+                      inside the per-shard fetch/verify loop of a
+                      peer-RAM restore — a corrupt/truncated replica
+                      shard mid-fetch.  Contract: the restore silently
+                      falls back to the storage path with a warning,
+                      byte-identical state, ``restore_source=storage``.
 """
 
 from __future__ import annotations
@@ -110,6 +135,9 @@ KNOWN_FAULT_POINTS = frozenset({
     "kernel_autotune_cache",
     "elastic_heartbeat",
     "slice_loss",
+    "elastic_readmit",
+    "ckpt_replica_push",
+    "ckpt_replica_restore",
 })
 
 

@@ -510,13 +510,18 @@ def _elastic_secondary_main() -> None:
     """Child process: the elastic slice-loss recovery leg.
 
     Runs the deterministic drill (``analysis/elastic_drill.py``) on the
-    8-virtual-device dcn_dp=2 mesh: train, async-checkpoint, lose a slice,
-    shrink to dcn_dp=1, rescale by the documented rule, resume from the
-    last committed step, finish.  Absolute seconds on virtual CPU devices
-    are not chip-meaningful — the leg exists so ``recovery_time_s`` stays
-    BOUNDED (a hang or an operator-action regression shows up as a null/
-    timeout here) and ``goodput_fraction`` is tracked run over run.
-    ``BENCH_ELASTIC=0`` skips the leg.
+    8-virtual-device dcn_dp=2 mesh: train, async-checkpoint (which now
+    pushes a peer-RAM replica after each commit), lose a slice, shrink to
+    dcn_dp=1, rescale by the documented rule, resume from the last
+    committed step — out of a NEIGHBOR SLICE'S RAM replica when one
+    matches — and finish.  Absolute seconds on virtual CPU devices are not
+    chip-meaningful — the leg exists so ``recovery_time_s`` stays BOUNDED
+    (a hang or an operator-action regression shows up as a null/timeout
+    here), ``goodput_fraction`` is tracked run over run, and
+    ``restore_time_s_peer_ram`` / ``restore_time_s_storage`` split the
+    restore latency by source (the fast-restore layer's own metric: the
+    recovery restore should land in the peer_ram bucket, the oracle's
+    storage restore in the other).  ``BENCH_ELASTIC=0`` skips the leg.
     """
     if os.environ.get("BENCH_ELASTIC", "1") == "0":
         raise SystemExit("BENCH_ELASTIC=0: elastic leg skipped")
@@ -542,10 +547,14 @@ def _elastic_secondary_main() -> None:
     dev = report["max_dev_vs_uninterrupted"]
     assert dev is not None and dev < 1e-3, (
         f"post-recovery trajectory diverged by {dev}")
+    rsplit = report.get("restore_time_by_source", {})
     print(json.dumps({
         "tps": round(report["recovery_time_s"], 3),
         "recovery_time_s": round(report["recovery_time_s"], 3),
         "goodput_fraction": round(report["goodput_fraction"], 4),
+        "restore_source": report.get("restore_source"),
+        "restore_time_s_peer_ram": round(rsplit.get("peer_ram", 0.0), 4),
+        "restore_time_s_storage": round(rsplit.get("storage", 0.0), 4),
     }))
 
 

@@ -17,6 +17,7 @@ otherwise never executes (``process_count() == 1`` everywhere else in CI):
 * cross-host metric agreement (both ranks see the same replicated loss).
 """
 
+import functools
 import os
 import socket
 import subprocess
@@ -24,6 +25,75 @@ import sys
 import textwrap
 
 import pytest
+
+
+# Capability probe: this container's jaxlib CPU backend cannot execute
+# cross-process computations — a jitted program whose output sharding spans
+# two processes' devices fails with ``INVALID_ARGUMENT: Multiprocess
+# computations aren't implemented on the CPU backend`` inside recipe
+# setup, so the two e2e tests below are structurally un-runnable here (not
+# flaky, not a regression).  The probe runs the minimal reproduction — two
+# real ``jax.distributed`` processes jitting one cross-process-sharded
+# zeros() — and the tests skip iff it fails.  TRACKING: remove this gate
+# (and let the tests run) once the container's jaxlib grows multiprocess
+# CPU execution; the probe is deliberately the capability itself, so the
+# gate lifts automatically on an upgraded image.  The skipif condition is
+# a lazy STRING (evaluated at test setup, slow tier only) so tier-1
+# collection never pays the ~10s probe.
+_PROBE = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    proc_id = int(sys.argv[1]); port = sys.argv[2]
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=2, process_id=proc_id)
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = jax.sharding.Mesh(jax.devices(), ("x",))
+    out = jax.jit(lambda: jnp.zeros((jax.device_count(),)),
+                  out_shardings=NamedSharding(mesh, P("x")))()
+    jax.block_until_ready(out)
+    print("MULTIPROCESS_CPU_OK")
+""")
+
+
+@functools.lru_cache(maxsize=1)
+def _multiprocess_cpu_supported() -> bool:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=4")
+    env["XLA_FLAGS"] = " ".join(flags)
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _PROBE, str(i), str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            return False
+        outs.append(out)
+    return all(p.returncode == 0 for p in procs) and all(
+        "MULTIPROCESS_CPU_OK" in o for o in outs)
+
+
+_MULTIPROCESS_SKIP = pytest.mark.skipif(
+    "not _multiprocess_cpu_supported()",
+    reason="this jaxlib's CPU backend cannot execute multiprocess "
+           "computations (probe failed: 'Multiprocess computations "
+           "aren't implemented on the CPU backend') — gate lifts "
+           "automatically on an image whose jaxlib supports it")
+
 
 _CHILD = textwrap.dedent("""
     import os, sys, json
@@ -103,6 +173,7 @@ def _run_two_ranks(child_src, extra_argv, env, root, timeout=480):
 
 
 @pytest.mark.slow
+@_MULTIPROCESS_SKIP
 def test_two_process_recipe_trains_and_checkpoints(tmp_path, subprocess_env):
     root = os.path.join(os.path.dirname(__file__), "..", "..")
     env = subprocess_env(4)
@@ -182,6 +253,7 @@ _VLM_CHILD = textwrap.dedent("""
 
 
 @pytest.mark.slow
+@_MULTIPROCESS_SKIP
 def test_two_process_vlm_pixel_pipeline(subprocess_env):
     """The VLM recipe's per-host pixel_values path
     (``make_array_from_process_local_data``) never executed multi-process

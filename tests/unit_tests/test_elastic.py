@@ -28,11 +28,15 @@ import sys
 import numpy as np
 import pytest
 
+from automodel_tpu.checkpoint import replication
 from automodel_tpu.utils import fault_injection as fi
 from automodel_tpu.utils.elastic import (
     ElasticCoordinator,
     SliceLostError,
+    SliceReturnedError,
     build_elastic_config,
+    rescale_between,
+    rescale_for_slice_gain,
     rescale_for_slice_loss,
     rescale_lr_only,
 )
@@ -43,8 +47,10 @@ pytestmark = pytest.mark.fault
 @pytest.fixture(autouse=True)
 def _clean_faults():
     fi.reset_faults()
+    replication.reset()
     yield
     fi.reset_faults()
+    replication.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -78,6 +84,63 @@ def test_rescale_lr_only_arm_and_validation():
             rescale_for_slice_loss(*bad)
         with pytest.raises(ValueError):
             rescale_lr_only(*bad)
+
+
+def test_rescale_gain_rule_and_validation():
+    # the canonical grow-back: old divides new -> pure accumulation
+    # decrease, LR untouched (exact inverse of the 2->1 shrink)
+    r = rescale_for_slice_gain(1, 2)
+    assert (r.accum_factor, r.accum_divisor) == (1, 2)
+    assert r.lr_scale == 1.0
+    assert r.target_accum(4) == (2, 1.0)
+    # non-divisible gain: divisor is new//gcd, LR inverts the loss arm's
+    # exact rational
+    r = rescale_for_slice_gain(2, 3)
+    assert r.accum_divisor == 3
+    assert (r.lr_num, r.lr_den) == (1, 2)
+    # checkpoint accumulation that never paid the matching shrink: the
+    # residual tokens/step ratio folds into a linear LR scale so the
+    # per-token LR is STILL exact (1 accum at the floor, ratio 3/4)
+    r = rescale_for_slice_gain(3, 4)
+    new_accum, residual = r.target_accum(3)
+    assert new_accum == 1  # 3/4 is not integral: floor(0) clamps to 1
+    assert residual == pytest.approx(4 / 3)
+    # domain errors name the other arm (full-contract messages)
+    for bad in ((2, 2), (3, 2), (0, 1)):
+        with pytest.raises(ValueError, match="rescale_for_slice_loss"):
+            rescale_for_slice_gain(*bad)
+    with pytest.raises(ValueError, match="rescale_for_slice_gain"):
+        rescale_for_slice_loss(2, 3)
+    with pytest.raises(ValueError, match="rescale_for_slice_gain"):
+        rescale_lr_only(2, 3)
+
+
+def test_rescale_round_trip_property_exact():
+    """The satellite pin: ``loss(a, b)`` then ``gain(b, a)`` restores the
+    original ``(accum, lr)`` regime EXACTLY for all 1 <= b < a <= 8 —
+    accumulation through integer arithmetic, LR through the exact
+    ``lr_num/lr_den`` rationals (floats round; the rationals must not)."""
+    for a in range(2, 9):
+        for b in range(1, a):
+            for accum0 in (1, 2, 3, 8):
+                down = rescale_for_slice_loss(a, b)
+                accum1, res1 = down.target_accum(accum0)
+                assert res1 == 1.0  # shrinks are always integral
+                up = rescale_for_slice_gain(b, a)
+                accum2, res2 = up.target_accum(accum1)
+                assert (accum2, res2) == (accum0, 1.0), (
+                    f"accum round trip {a}->{b}->{a} from {accum0}: "
+                    f"got {accum2} (residual {res2})")
+                # exact rational identity: down.lr * up.lr == 1
+                assert down.lr_num * up.lr_num == down.lr_den * up.lr_den, (
+                    f"lr rational round trip {a}->{b}->{a}: "
+                    f"{down.lr_num}/{down.lr_den} * {up.lr_num}/{up.lr_den}")
+    # the dispatcher agrees with the arms and is identity on equality
+    assert rescale_between(4, 2).accum_factor == 2
+    assert rescale_between(2, 4).accum_divisor == 2
+    ident = rescale_between(3, 3)
+    assert (ident.accum_factor, ident.accum_divisor,
+            ident.lr_scale) == (1, 1, 1.0)
 
 
 def test_elastic_config_build():
@@ -119,6 +182,34 @@ def test_mesh_shrink_slices_builds_survivor_mesh():
         mm.shrink_slices(5)
     with pytest.raises(ValueError, match="single-slice"):
         survivors.shrink_slices(0)
+
+
+def test_mesh_grow_slices_is_the_shrink_inverse():
+    from automodel_tpu.distributed.mesh import MeshManager
+
+    mm = MeshManager(dcn_dp_size=2, dp_size=4, tp_size=2)
+    lost_ids = [d.id for d in mm.slice_devices(1)]
+    shrunk = mm.shrink_slices(1)
+    # the shrink REMEMBERS the retired slice (devices + host processes)
+    assert set(shrunk.retired_slices) == {1}
+    assert [d.id for d in shrunk.retired_slices[1]] == lost_ids
+    assert shrunk.retired_slice_processes(1) == (0,)
+    # grow-back: dcn_dp+1, returned slice appended LAST, same geometry
+    grown = shrunk.grow_slices(1)
+    assert grown.dcn_dp_size == 2 and grown.world_size == 8
+    assert [d.id for d in grown.slice_devices(1)] == lost_ids
+    assert grown.retired_slices == {}
+    assert grown.shape == mm.shape
+    # errors: nothing retired / unknown token / wrong device count
+    with pytest.raises(ValueError, match="no retired slice"):
+        mm.grow_slices()
+    with pytest.raises(ValueError, match="not a retired slice"):
+        shrunk.grow_slices(7)
+    with pytest.raises(ValueError, match="per-slice geometry"):
+        shrunk.grow_slices(devices=mm.slice_devices(0)[:2])
+    # a replacement slice (explicit devices) is admissible too
+    replacement = shrunk.grow_slices(devices=mm.slice_devices(1))
+    assert replacement.dcn_dp_size == 2
 
 
 def test_mesh_unknown_kwargs_warn_and_strict_raises(caplog):
@@ -210,6 +301,108 @@ def test_detect_latency_tracks_poll_gap():
     coord.poll(2)
     assert coord.detect_latency_s() >= 0.0
     assert coord.prev_poll_t is not None
+
+
+# ---------------------------------------------------------------------------
+# Grow-back: probation protocol + admission
+# ---------------------------------------------------------------------------
+def _shrunk_coordinator(probation=3):
+    from automodel_tpu.distributed.mesh import MeshManager
+
+    mm = MeshManager(dcn_dp_size=2, dp_size=4, tp_size=2)
+    return ElasticCoordinator(mm.shrink_slices(1), heartbeat_timeout_s=1.0,
+                              readmit_probation_polls=probation)
+
+
+def test_readmit_probation_counts_consecutive_healthy_polls():
+    coord = _shrunk_coordinator(probation=3)
+    # the drilled return becomes visible at the SECOND poll
+    fi.configure_faults("elastic_readmit:2")
+    coord.poll(1)
+    assert coord.ready_to_readmit() is None
+    coord.poll(2)  # visible: streak 1
+    coord.poll(3)  # streak 2
+    assert coord.ready_to_readmit() is None  # probation not served yet
+    coord.poll(4)  # streak 3 == probation
+    assert coord.ready_to_readmit() == 1
+    # admission returns the typed event and clears the streak
+    ev = coord.admit(1, step=4)
+    assert isinstance(ev, SliceReturnedError)
+    assert ev.slice_id == 1 and ev.detected_at_step == 4
+    assert coord.ready_to_readmit() is None
+
+
+def test_readmit_flap_restarts_probation():
+    coord = _shrunk_coordinator(probation=2)
+    fi.configure_faults("elastic_readmit:1")
+    coord.poll(1)  # visible: streak 1
+    # the slice flaps (its heartbeats vanish again): streak must restart
+    coord._returned_visible.clear()
+    coord.poll(2)
+    assert coord.ready_to_readmit() is None
+    assert coord._probation == {}
+
+
+def test_readmit_without_retired_slices_is_inert():
+    coord = _coordinator()  # full mesh: nothing retired
+    fi.configure_faults("elastic_readmit:1")
+    coord.poll(1)
+    coord.poll(2)
+    # the fault point is never reached (no retired slices), nothing fires
+    assert coord.ready_to_readmit() is None
+    assert fi.fault_counts().get("elastic_readmit") == 0
+
+
+def test_is_ready_is_per_slice_not_global_minimum():
+    """A latched higher-token slice must not read as flapped just because
+    a LOWER token finished probation after the latch: ``is_ready`` checks
+    the one slice, ``ready_to_readmit`` picks the latch candidate."""
+    coord = _shrunk_coordinator(probation=1)
+    coord._probation = {0: 1, 3: 1}
+    assert coord.ready_to_readmit() == 0  # latch order: lowest first
+    assert coord.is_ready(3) and coord.is_ready(0)
+    assert not coord.is_ready(7)
+
+
+def test_grow_slices_default_is_most_recently_retired():
+    """Retirement RECENCY is insertion order, not token magnitude: losing
+    slice 2 then slice 0 must default-readmit 0 (the latest loss), and the
+    drill's default pick agrees."""
+    from automodel_tpu.distributed.mesh import MeshManager
+
+    mm = MeshManager(dcn_dp_size=4, dp_size=4, tp_size=2)
+    shrunk = mm.shrink_slices(2).shrink_slices(0)
+    assert list(shrunk.retired_slices) == [2, 0]
+    grown = shrunk.grow_slices()
+    assert list(grown.retired_slices) == [2], (
+        "the default grow must re-admit the MOST RECENTLY retired slice")
+    coord = ElasticCoordinator(shrunk, readmit_probation_polls=1)
+    assert coord._drilled_returned_slice(shrunk.retired_slices) == 0
+
+
+def test_agree_readmit_single_process_passthrough_and_no_client():
+    """Single-process: the local verdict IS the pool's (no KV round).
+    Multi-host without a coordination client: never admit."""
+    coord = _shrunk_coordinator(probation=1)
+    assert coord.agree_readmit(1, step=4) == 1
+    assert coord.agree_readmit(None, step=4) is None
+    # the returning-host handshake is a no-op off a real pool
+    assert coord.wait_for_admission(1) == -1
+
+
+def test_returned_slice_env_picks_the_slice(monkeypatch):
+    from automodel_tpu.distributed.mesh import MeshManager
+
+    mm = MeshManager(dcn_dp_size=4, dp_size=4, tp_size=2)
+    coord = ElasticCoordinator(mm.shrink_slices(0).shrink_slices(0),
+                               readmit_probation_polls=1)
+    # stacked shrinks both lost "slice 0" of their day: the second token
+    # is bumped past the first (0, then 0 + dcn_dp(3) = 3)
+    assert set(coord.mesh_manager.retired_slices) == {0, 3}
+    monkeypatch.setenv("AUTOMODEL_RETURNED_SLICE", "0")
+    fi.configure_faults("elastic_readmit:1")
+    coord.poll(1)
+    assert coord.ready_to_readmit() == 0
 
 
 # ---------------------------------------------------------------------------
@@ -327,6 +520,190 @@ def test_recipe_elastic_recovery_end_to_end(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Grow-back end to end
+# ---------------------------------------------------------------------------
+@pytest.mark.core
+def test_growback_drill_heals_to_original_regime(tmp_path):
+    """The full heal cycle (ISSUE 11 acceptance): lose a slice, recover
+    from the PEER RAM replica, re-admit after probation at a committed-
+    checkpoint boundary, land back on the original regime, finish with
+    parity vs an uninterrupted dcn_dp=2 run (asserts inside the drill:
+    restore_source=peer_ram on the loss restore, zero-step grow-back,
+    grad_acc round trip, assert_compiles_once on the re-grown step)."""
+    from automodel_tpu.analysis.elastic_drill import run_growback_drill
+
+    fi.configure_faults("slice_loss:4,elastic_readmit:1")
+    report = run_growback_drill(str(tmp_path), total_steps=8, save_step=2,
+                                fault_step=4, probation_polls=2)
+    assert report["recovery"]["restore_source"] == "peer_ram"
+    assert report["growback"]["restore_source"] == "storage"
+    assert report["growback"]["grad_acc_steps"] == 2
+    assert report["admitted_step"] is not None
+    dev = report["max_dev_vs_uninterrupted"]
+    assert dev is not None and dev < 1e-3, (
+        f"post-grow-back trajectory diverged by {dev}")
+    # the restore-latency split is populated on both sides (bench surface)
+    split = report["restore_time_by_source"]
+    assert split["peer_ram"] > 0.0 and split["storage"] > 0.0
+    assert 0.0 <= report["goodput_fraction"] < 1.0
+
+
+def test_recipe_growback_resets_recovery_budget(tmp_path, monkeypatch):
+    """Recipe-level grow-back + the budget-reset satellite: with
+    ``max_recoveries=1``, the run survives loss -> grow-back -> SECOND
+    loss only because a successful grow-back resets the recovery budget
+    (without the reset the second loss exceeds the budget and the run
+    dies).  Uses a scripted coordinator so both losses and the return are
+    deterministic while the REAL mesh/reconfigure/input-rebuild machinery
+    runs underneath."""
+    from automodel_tpu.config.arg_parser import parse_args_and_load_config
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
+    )
+    from automodel_tpu.utils import elastic as el
+
+    class ScriptedCoordinator(el.ElasticCoordinator):
+        """Deterministic script over the REAL probation machinery: loss #1
+        at step >= 2; while shrunk (and not yet healed) the retired slice
+        heartbeats, so probation + commit-boundary admission grow it back;
+        once healed, loss #2 at step >= 6; after that the slice stays
+        down, so the run finishes shrunk."""
+
+        losses_done = 0
+        healed = False
+
+        def poll(self, step=-1):
+            self._poll_seq += 1
+            import time as _t
+
+            self.prev_poll_t, self.last_poll_t = (self.last_poll_t,
+                                                  _t.monotonic())
+            retired = self.mesh_manager.retired_slices
+            if retired and not type(self).healed:
+                # the lost slice is back up: advance REAL probation state
+                self._returned_visible.update(retired)
+            visible = self._returned_visible & set(retired)
+            for s in list(self._probation):
+                if s not in visible:
+                    del self._probation[s]
+            for s in visible:
+                self._probation[s] = self._probation.get(s, 0) + 1
+            if not retired and type(self).losses_done == 0 and step >= 2:
+                type(self).losses_done = 1
+                raise el.SliceLostError(1, "scripted loss #1", step)
+            if (not retired and type(self).losses_done == 1
+                    and type(self).healed and step >= 6):
+                type(self).losses_done = 2
+                raise el.SliceLostError(1, "scripted loss #2", step)
+
+        def admit(self, slice_id, step=-1):
+            type(self).healed = True
+            return super().admit(slice_id, step)
+
+    monkeypatch.setattr(el, "ElasticCoordinator", ScriptedCoordinator)
+    yaml = os.path.join(os.path.dirname(__file__), "..", "..",
+                        "examples", "llm_finetune", "tiny_llama_mock.yaml")
+    cfg = parse_args_and_load_config([
+        "--config", yaml,
+        "--checkpoint.checkpoint_dir", str(tmp_path),
+        "--checkpoint.model_save_format", "orbax",
+        "--checkpoint.save_consolidated", "false",
+        "--distributed.dcn_dp_size", "2",
+        "--elastic.heartbeat_interval_steps", "1",
+        "--elastic.max_recoveries", "1",
+        "--elastic.readmit_probation_polls", "1",
+        "--step_scheduler.ckpt_every_steps", "2",
+        "--step_scheduler.max_steps", "8",
+        "--step_scheduler.val_every_steps", "null",
+    ])
+    recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+    assert recipe.mesh_manager.dcn_dp_size == 2
+    recipe.run_train_validation_loop()
+    # the run FINISHED: loss #1 (budget 1/1) -> grow-back (budget reset)
+    # -> loss #2 (budget 1/1 again) all absorbed
+    assert recipe.step_scheduler.step == 8, "run must finish its budget"
+    assert recipe.mesh_manager.dcn_dp_size == 1, (
+        "the scripted second loss must have shrunk the healed mesh again")
+    assert np.isfinite(recipe.last_metrics["loss"])
+    # regime trace: accum 2 (dcn=2) -> 4 (loss #1) -> 2 (grow-back, exact
+    # inverse) -> 4 (loss #2); the final state proves BOTH the grow-back
+    # and the second recovery ran
+    assert recipe.step_scheduler.grad_acc_steps == 4
+    assert recipe.elastic_state.dcn_dp == 1
+    assert recipe.mesh_manager.retired_slices, (
+        "the re-shrunk mesh must remember the newly retired slice")
+
+
+def test_pending_readmit_revalidated_at_commit_boundary(
+        tmp_path, monkeypatch, caplog):
+    """A latched re-admission must be REVALIDATED at the checkpoint
+    boundary: if the slice flapped after probation passed (its streak
+    reset), the admission is abandoned with a warning — never grow the
+    mesh back over a dead slice — and the slice re-qualifies via a fresh
+    probation window later."""
+    import logging
+
+    from automodel_tpu.config.arg_parser import parse_args_and_load_config
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
+    )
+    from automodel_tpu.utils import elastic as el
+
+    class FlapCoordinator(el.ElasticCoordinator):
+        """Loss at step 4 (after the step-3 commit).  The slice looks
+        healthy at the step-4 poll (probation served -> latched), FLAPS at
+        the step-5 poll — the last poll the step-6 checkpoint boundary
+        sees — so the boundary must abandon the latched admission; healthy
+        again afterwards, so the step-9 boundary re-admits it."""
+
+        lost = False
+
+        def poll(self, step=-1):
+            self._poll_seq += 1
+            import time as _t
+
+            self.prev_poll_t, self.last_poll_t = (self.last_poll_t,
+                                                  _t.monotonic())
+            retired = self.mesh_manager.retired_slices
+            if not retired and not type(self).lost and step >= 4:
+                type(self).lost = True
+                raise el.SliceLostError(1, "scripted loss", step)
+            if retired:
+                if step == 5:  # the flap: streak reset before the boundary
+                    self._probation = {}
+                else:
+                    self._probation = {t: 1 for t in retired}
+
+    monkeypatch.setattr(el, "ElasticCoordinator", FlapCoordinator)
+    yaml = os.path.join(os.path.dirname(__file__), "..", "..",
+                        "examples", "llm_finetune", "tiny_llama_mock.yaml")
+    cfg = parse_args_and_load_config([
+        "--config", yaml,
+        "--checkpoint.checkpoint_dir", str(tmp_path),
+        "--checkpoint.model_save_format", "orbax",
+        "--checkpoint.save_consolidated", "false",
+        "--distributed.dcn_dp_size", "2",
+        "--elastic.heartbeat_interval_steps", "1",
+        "--elastic.readmit_probation_polls", "1",
+        "--step_scheduler.ckpt_every_steps", "3",
+        "--step_scheduler.max_steps", "9",
+        "--step_scheduler.val_every_steps", "null",
+    ])
+    recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg).setup()
+    with caplog.at_level(logging.WARNING,
+                         "automodel_tpu.recipes.llm.train_ft"):
+        recipe.run_train_validation_loop()
+    assert any("abandoned" in r.message and "flapped" in r.message
+               for r in caplog.records), (
+        "the step-6 boundary must have abandoned the flapped admission")
+    # the healthy window re-qualified the slice: the run still healed
+    assert recipe.step_scheduler.step == 9
+    assert recipe.mesh_manager.dcn_dp_size == 2
+    assert recipe.step_scheduler.grad_acc_steps == 2
+    assert np.isfinite(recipe.last_metrics["loss"])
+
+
+# ---------------------------------------------------------------------------
 # Kill-mode drills: the process IS the dying slice
 # ---------------------------------------------------------------------------
 def _run_kill_child(tmp_path, subprocess_env, fault_spec, body):
@@ -387,6 +764,34 @@ def test_elastic_heartbeat_kill_mid_async_commit_resumes_previous_step(
     out = drill_phase2_resume(str(tmp_path), expect_step=2, extra_steps=2)
     assert out["restored_step"] == 2
     assert all(np.isfinite(v[0]) for v in out["metrics"].values())
+
+
+def test_elastic_readmit_kill_mid_probation_stays_shrunk(
+        tmp_path, subprocess_env):
+    """``elastic_readmit:1:kill``: this host dies while tracking a
+    re-admission (the first poll after the loss, where the point is first
+    reached).  The pool never grows back; the committed checkpoint from
+    before the loss survives and the relaunch at the SHRUNK topology
+    resumes from it — healing must never put recovery at risk."""
+    proc = _run_kill_child(
+        tmp_path, subprocess_env, "slice_loss:3,elastic_readmit:1:kill",
+        "from automodel_tpu.analysis.elastic_drill import "
+        "run_growback_drill\n"
+        f"run_growback_drill({str(tmp_path)!r}, total_steps=8, "
+        "save_step=2, fault_step=3, probation_polls=2)\n")
+    assert proc.returncode == fi._KILL_EXIT_CODE, proc.stderr[-2000:]
+    from automodel_tpu.checkpoint.checkpointing import (
+        find_latest_checkpoint,
+        verify_manifest,
+    )
+
+    latest = find_latest_checkpoint(str(tmp_path / "elastic_ckpt"))
+    assert latest is not None and verify_manifest(latest)["step"] == 2
+    # relaunch at the shrunk topology resumes without operator action
+    from automodel_tpu.analysis.elastic_drill import drill_phase2_resume
+
+    out = drill_phase2_resume(str(tmp_path), expect_step=2, extra_steps=1)
+    assert out["restored_step"] == 2
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,405 @@
+"""Peer-to-peer in-memory checkpoint replication (ISSUE 11 tentpole):
+push-after-commit, digest-verified peer-RAM restore, storage fallback.
+
+Tier-1 surface:
+
+* serialize/rebuild round-trips every leaf byte-exactly (incl. the 0-d
+  scalar shapes ``np.ascontiguousarray`` silently promotes — a real bug
+  this suite pins);
+* the replica store keeps exactly ONE generation (bounded memory), keyed
+  by the ``(checkpoint path, step)`` identity so runs sharing a process
+  can never cross-restore, and a lost slice's store dies with it
+  (``drop_slice`` + ring-neighbor placement);
+* ``ckpt_replica_push`` (raise + kill) never un-lands a committed save;
+* ``ckpt_replica_restore`` — the restore-degradation satellite: a corrupt
+  replica shard mid-fetch falls back to the storage path silently (one
+  warning), byte-identical params, ``restore_source=storage``;
+* replication adds ZERO device collectives: the ``dcn2_dp2xtp2`` census
+  is byte-identical to its golden after a full push/restore cycle.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from automodel_tpu.checkpoint import replication
+from automodel_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fi.reset_faults()
+    replication.reset()
+    yield
+    fi.reset_faults()
+    replication.reset()
+
+
+def _mesh2():
+    from automodel_tpu.distributed.mesh import MeshManager
+
+    return MeshManager(dcn_dp_size=2, dp_size=4, tp_size=2)
+
+
+def _tiny_trees():
+    import ml_dtypes
+
+    return {
+        "params": {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                   "b": np.ones((4,), ml_dtypes.bfloat16)},
+        "opt": {"count": np.asarray(7, np.int32),   # 0-d: the shape bug
+                "mu": {"w": np.full((3, 4), 0.5, np.float32)}},
+    }
+
+
+# ---------------------------------------------------------------------------
+# Serialization + store semantics (no recipes, no jit)
+# ---------------------------------------------------------------------------
+def test_serialize_rebuild_round_trip_including_scalars():
+    import jax
+
+    trees = _tiny_trees()
+    shards = replication.serialize_tree(trees)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        trees)
+    rebuilt = replication._rebuild_tree(abstract, shards)
+    for (ka, a), (kb, b) in zip(
+            replication._flatten_with_keys(trees),
+            replication._flatten_with_keys(rebuilt)):
+        assert ka == kb
+        assert np.asarray(a).shape == np.asarray(b).shape  # 0-d stays 0-d
+        assert np.asarray(a).dtype == np.asarray(b).dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_push_ring_targets_single_generation_and_path_identity(tmp_path):
+    import jax
+
+    mm = _mesh2()
+    trees = _tiny_trees()
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        trees)
+    ck1 = str(tmp_path / "run_a" / "epoch_0_step_1")
+    assert replication.push_replica(
+        epoch=0, step=1, trees=trees, mesh_manager=mm,
+        checkpoint_dir=str(tmp_path / "run_a"), ckpt_path=ck1)
+    # emulated single process owns every slice: both ring stores populated
+    snap = replication.stores_snapshot()
+    assert set(snap) == {0, 1} and all(v[1] == 1 for v in snap.values())
+    # catalog mirror written beside the checkpoints
+    cats = replication.read_catalogs(str(tmp_path / "run_a"))
+    assert len(cats) == 1 and cats[0]["step"] == 1
+    assert len(cats[0]["shards"]) == 4
+
+    # a later push REPLACES the generation (bounded memory)
+    ck2 = str(tmp_path / "run_a" / "epoch_0_step_2")
+    replication.push_replica(
+        epoch=0, step=2, trees=trees, mesh_manager=mm,
+        checkpoint_dir=str(tmp_path / "run_a"), ckpt_path=ck2)
+    assert all(v[1] == 2 for v in replication.stores_snapshot().values())
+    assert replication.restore_from_peers(
+        step=1, abstract=abstract, ckpt_path=ck1) is None
+    assert replication.restore_from_peers(
+        step=2, abstract=abstract, ckpt_path=ck2) is not None
+    # the (path, step) identity: a DIFFERENT run's step-2 checkpoint must
+    # never be served by this run's replica
+    assert replication.restore_from_peers(
+        step=2, abstract=abstract,
+        ckpt_path=str(tmp_path / "run_b" / "epoch_0_step_2")) is None
+
+
+def test_single_slice_pool_skips_push(tmp_path):
+    from automodel_tpu.distributed.mesh import MeshManager
+
+    mm = MeshManager(dp_size=4, tp_size=2)  # dcn_dp == 1: no peer
+    assert not replication.push_replica(
+        epoch=0, step=1, trees=_tiny_trees(), mesh_manager=mm,
+        checkpoint_dir=str(tmp_path))
+    assert replication.stores_snapshot() == {}
+
+
+def test_drop_slice_models_dead_ram():
+    import jax
+
+    mm = _mesh2()
+    trees = _tiny_trees()
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        trees)
+    replication.push_replica(epoch=0, step=3, trees=trees, mesh_manager=mm,
+                             ckpt_path="/ck/epoch_0_step_3")
+    replication.drop_slice(1)  # the lost slice's RAM is gone
+    # the ring neighbor's copy still serves the restore
+    assert replication.restore_from_peers(
+        step=3, abstract=abstract,
+        ckpt_path="/ck/epoch_0_step_3") is not None
+    replication.drop_slice(0)
+    assert replication.restore_from_peers(
+        step=3, abstract=abstract,
+        ckpt_path="/ck/epoch_0_step_3") is None
+
+
+def test_stacked_losses_drop_dead_store_despite_renumbering():
+    """Store keys are push-time slice indices; survivors renumber after a
+    shrink.  A SECOND loss before any new push must still drop the newly
+    dead slice's store — identified by its DEVICE IDS, not its (shifted)
+    current index."""
+    from automodel_tpu.distributed.mesh import MeshManager
+
+    mm = MeshManager(dcn_dp_size=4, dp_size=4, tp_size=2)  # 4 slices x 2
+    replication.push_replica(epoch=0, step=1, trees=_tiny_trees(),
+                             mesh_manager=mm, ckpt_path="/ck/epoch_0_step_1")
+    assert set(replication.stores_snapshot()) == {0, 1, 2, 3}
+    # loss #1: slice 0 dies
+    replication.drop_slice(0, devices=[d.id for d in mm.slice_devices(0)])
+    shrunk = mm.shrink_slices(0)
+    assert set(replication.stores_snapshot()) == {1, 2, 3}
+    # loss #2 BEFORE any new push: the slice now called 0 is ORIGINAL
+    # slice 1 — a bare-index drop would pop nothing (store 0 is already
+    # gone) and leave the dead slice's RAM serving restores
+    dead_devs = [d.id for d in shrunk.slice_devices(0)]
+    replication.drop_slice(0, devices=dead_devs)
+    assert set(replication.stores_snapshot()) == {2, 3}, (
+        "the dead slice's push-time store (key 1) must be gone")
+
+
+def test_restore_fault_degrades_to_none_with_warning(caplog):
+    import logging
+
+    import jax
+
+    mm = _mesh2()
+    trees = _tiny_trees()
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        trees)
+    replication.push_replica(epoch=0, step=1, trees=trees, mesh_manager=mm,
+                             ckpt_path="/ck/epoch_0_step_1")
+    fi.configure_faults("ckpt_replica_restore:2")  # 2nd shard mid-fetch
+    with caplog.at_level(logging.WARNING,
+                         "automodel_tpu.checkpoint.replication"):
+        out = replication.restore_from_peers(
+            step=1, abstract=abstract, ckpt_path="/ck/epoch_0_step_1")
+    assert out is None
+    assert any("falling back to the storage restore path" in r.message
+               for r in caplog.records)
+
+
+def test_corrupt_shard_digest_detected():
+    import jax
+
+    mm = _mesh2()
+    trees = _tiny_trees()
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        trees)
+    replication.push_replica(epoch=0, step=1, trees=trees, mesh_manager=mm,
+                             ckpt_path="/ck/epoch_0_step_1")
+    # flip bytes in one resident shard: the digest catches it at fetch
+    with replication._lock:
+        gen = replication._STORES[0].gen
+        key = sorted(gen.shards)[0]
+        digest, buf, dtype, shape = gen.shards[key]
+        gen.shards[key] = (digest, b"\x00" * len(buf), dtype, shape)
+    assert replication.restore_from_peers(
+        step=1, abstract=abstract, ckpt_path="/ck/epoch_0_step_1") is None
+
+
+# ---------------------------------------------------------------------------
+# Recipe-level: push on commit, peer restore, degradation satellite
+# ---------------------------------------------------------------------------
+def _drill_recipe(ckpt_dir, **kw):
+    from automodel_tpu.analysis.elastic_drill import _build_recipe
+
+    return _build_recipe(str(ckpt_dir), **kw)
+
+
+def _host_bytes(tree):
+    import jax
+
+    return [np.asarray(leaf).tobytes()
+            for leaf in jax.tree.leaves(jax.device_get(tree))]
+
+
+def test_async_commit_pushes_replica_and_recovery_restores_from_peer(
+        tmp_path):
+    """The integration contract: an async save's commit pushes one
+    generation; a slice-loss recovery drops the dead store, restores the
+    params/opt payload out of the surviving neighbor's RAM
+    (``restore_source=peer_ram``), and the bytes equal a storage restore
+    of the same checkpoint."""
+    from automodel_tpu.utils.elastic import SliceLostError
+
+    rec = _drill_recipe(tmp_path, dcn_dp=2)
+    final = rec.save_checkpoint(0, 1)
+    rec.join_pending_save()
+    snap = replication.stores_snapshot()
+    assert set(snap) == {0, 1} and all(v[1] == 1 for v in snap.values())
+    assert replication.read_catalogs(str(tmp_path))  # mirror advertised
+
+    info = rec.recover_from_slice_loss(SliceLostError(1, "drill", 1))
+    assert info["restore_source"] == "peer_ram"
+    assert rec._restore_events[-1][0] == "peer_ram"
+    peer_bytes = _host_bytes({"p": rec.params, "o": rec.opt_state})
+    rec.teardown()
+
+    # oracle: the same checkpoint restored through STORAGE must be
+    # byte-identical (also proves the replica advertised committed state)
+    ref = _drill_recipe(tmp_path, dcn_dp=1,
+                        devices=rec.mesh_manager.mesh.devices.flatten())
+    ref.checkpoint_config.replicate_to_peers = False
+    assert ref.load_checkpoint() == final
+    assert ref._restore_source == "storage"
+    storage_bytes = _host_bytes({"p": ref.params, "o": ref.opt_state})
+    ref.teardown()
+    assert peer_bytes == storage_bytes
+    # restore-latency split recorded for both sources (bench surface)
+    from automodel_tpu.training.timers import restore_time_by_source
+
+    split = restore_time_by_source(
+        rec.timers.get_elapsed(reset=False))
+    assert split["peer_ram"] > 0.0
+
+
+def test_replica_restore_degradation_falls_back_to_storage(
+        tmp_path, caplog):
+    """The restore-path degradation satellite: corrupt/truncate a peer
+    replica shard mid-fetch (``ckpt_replica_restore`` fault) and the
+    recovery must silently fall back to storage — one warning, byte-
+    identical params, ``restore_source=storage`` in the recovery info."""
+    import logging
+
+    from automodel_tpu.utils.elastic import SliceLostError
+
+    rec = _drill_recipe(tmp_path, dcn_dp=2)
+    final = rec.save_checkpoint(0, 1)
+    rec.join_pending_save()
+    fi.configure_faults("ckpt_replica_restore:3")  # mid-fetch, 3rd shard
+    with caplog.at_level(logging.WARNING,
+                         "automodel_tpu.checkpoint.replication"):
+        info = rec.recover_from_slice_loss(SliceLostError(1, "drill", 1))
+    assert info["restore_source"] == "storage"
+    assert rec._restore_events[-1][0] == "storage"
+    assert any("falling back to the storage restore path" in r.message
+               for r in caplog.records)
+    fallback_bytes = _host_bytes({"p": rec.params, "o": rec.opt_state})
+    rec.teardown()
+
+    ref = _drill_recipe(tmp_path, dcn_dp=1,
+                        devices=rec.mesh_manager.mesh.devices.flatten())
+    ref.checkpoint_config.replicate_to_peers = False
+    assert ref.load_checkpoint() == final
+    assert fallback_bytes == _host_bytes({"p": ref.params,
+                                          "o": ref.opt_state})
+    ref.teardown()
+
+
+def test_push_fault_never_fails_the_committed_save(tmp_path, caplog):
+    """``ckpt_replica_push`` raise mode: the save STANDS (committed, no
+    error at the join point), the push is skipped with a warning, and the
+    NEXT save pushes normally."""
+    import logging
+
+    from automodel_tpu.checkpoint.checkpointing import is_committed
+
+    fi.configure_faults("ckpt_replica_push:1")
+    rec = _drill_recipe(tmp_path, dcn_dp=2)
+    with caplog.at_level(logging.WARNING,
+                         "automodel_tpu.recipes.base_recipe"):
+        final = rec.save_checkpoint(0, 1)
+        assert rec.join_pending_save() == final  # no CheckpointSaveError
+    assert is_committed(final)
+    assert replication.stores_snapshot() == {}  # push skipped
+    assert any("the commit stands" in r.message for r in caplog.records)
+    # the armed point fired once; the next save replicates normally
+    final2 = rec.save_checkpoint(0, 2)
+    rec.join_pending_save()
+    assert is_committed(final2)
+    assert all(v[1] == 2
+               for v in replication.stores_snapshot().values())
+    rec.teardown()
+
+
+def test_replicate_to_peers_false_disables_push(tmp_path):
+    rec = _drill_recipe(tmp_path, dcn_dp=2)
+    rec.checkpoint_config.replicate_to_peers = False
+    rec.save_checkpoint(0, 1)
+    rec.join_pending_save()
+    assert replication.stores_snapshot() == {}
+    rec.teardown()
+
+
+def test_ckpt_replica_push_kill_after_commit_leaves_committed_step(
+        tmp_path, subprocess_env):
+    """``ckpt_replica_push:1:kill``: the host dies ON the committer thread
+    right after its commit landed — the distinctive exit code proves the
+    kill fired there, the committed checkpoint survives for the relaunch,
+    and a fresh process (empty replica store) restores it from STORAGE."""
+    env = subprocess_env(8)
+    env[fi.FAULT_ENV] = "ckpt_replica_push:1:kill"
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from automodel_tpu.analysis.elastic_drill import _build_recipe\n"
+        f"rec = _build_recipe({str(tmp_path / 'ck')!r}, dcn_dp=2)\n"
+        "rec.save_checkpoint(0, 1)\n"
+        "rec.join_pending_save()\n"  # killed inside the committer first
+        "print('UNREACHABLE')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=540,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))))
+    assert proc.returncode == fi._KILL_EXIT_CODE, proc.stderr[-2000:]
+    assert "UNREACHABLE" not in proc.stdout
+    from automodel_tpu.checkpoint.checkpointing import (
+        find_latest_checkpoint,
+        verify_manifest,
+    )
+
+    latest = find_latest_checkpoint(str(tmp_path / "ck"))
+    assert latest is not None and verify_manifest(latest)["step"] == 1
+    # relaunch: fresh process == empty store; storage restore works
+    rec = _drill_recipe(tmp_path / "ck", dcn_dp=2)
+    assert rec.load_checkpoint() == latest
+    assert rec._restore_source == "storage"
+    rec.teardown()
+
+
+# ---------------------------------------------------------------------------
+# The zero-device-collectives pin
+# ---------------------------------------------------------------------------
+def test_replication_adds_zero_device_collectives(tmp_path):
+    """The golden-census pin of the acceptance criteria: after a FULL
+    push + peer-restore cycle in this process, the ``dcn2_dp2xtp2`` leg's
+    collective census still matches its golden byte-for-byte — replication
+    is host-RAM + KV traffic only and can never add a device collective
+    to the step."""
+    import jax
+
+    from automodel_tpu.analysis.jaxpr_audit import load_census
+    from automodel_tpu.analysis.legs import build_leg, golden_path
+
+    mm = _mesh2()
+    trees = _tiny_trees()
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        trees)
+    replication.push_replica(epoch=0, step=1, trees=trees, mesh_manager=mm,
+                             checkpoint_dir=str(tmp_path),
+                             ckpt_path=str(tmp_path / "epoch_0_step_1"))
+    assert replication.restore_from_peers(
+        step=1, abstract=abstract,
+        ckpt_path=str(tmp_path / "epoch_0_step_1")) is not None
+    census = build_leg("dcn2_dp2xtp2").census()
+    diff = census.diff(load_census(golden_path("dcn2_dp2xtp2")))
+    assert not diff, (
+        "replication changed the dcn2_dp2xtp2 device-collective census:\n  "
+        + "\n  ".join(diff))

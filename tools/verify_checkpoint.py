@@ -12,6 +12,18 @@ Usage::
     python tools/verify_checkpoint.py <ckpt_dir> [<ckpt_dir> ...]
     python tools/verify_checkpoint.py --root checkpoints/   # all committed
     python tools/verify_checkpoint.py --root checkpoints/ --latest
+    python tools/verify_checkpoint.py --root checkpoints/ --replicas
+
+``--replicas`` additionally reports the peer-replica catalogs the
+in-memory replication layer advertised (``checkpoint/replication.py``
+mirrors the KV catalog to ``replica_catalog.p<idx>.json`` beside the
+checkpoints): step, shard count, total bytes, and whether the advertised
+generation matches a committed on-disk checkpoint.  A catalog is the
+PUSH-TIME advertisement — the replica bytes live only in the training
+processes' RAM, so "matches committed" means a LIVE run's next recovery
+at that step restores from peer RAM; once the processes exit (or the
+pool evicted the generation, which also retracts the catalog) restores
+read storage.
 
 Exit code 0 iff every checked directory validates; 1 otherwise (so it
 slots into preflight scripts before resuming a long run).
@@ -42,6 +54,45 @@ def _verify_one(path: str, deep: bool) -> bool:
     return True
 
 
+def _report_replicas(root: str) -> None:
+    """Print the advertised peer-replica catalog(s) under ``root`` next to
+    the committed on-disk state — the operator view of the in-memory
+    fast-restore layer (``checkpoint/replication.py``)."""
+    from automodel_tpu.checkpoint import checkpointing as ckpt
+    from automodel_tpu.checkpoint import replication
+
+    catalogs = replication.read_catalogs(root)
+    if not catalogs:
+        print(f"note  {root}: no peer-replica catalog advertised "
+              "(no async save with replication ran here, or the pool has "
+              "a single slice)")
+        return
+    committed = {step: path
+                 for _e, step, path in ckpt.list_committed_checkpoints(root)}
+    for cat in catalogs:
+        shards = cat.get("shards", {})
+        total = sum(s.get("bytes", 0) for s in shards.values())
+        step = cat.get("step")
+        on_disk = committed.get(step)
+        digest_preview = ", ".join(
+            f"{k.split('.')[-1] or k}:{v['sha256'][:8]}"
+            for k, v in sorted(shards.items())[:3])
+        print(f"replica  {cat.get('_file')}: step {step}, "
+              f"{len(shards)} shard(s), {total / 1e6:.1f} MB "
+              f"(process {cat.get('process')}; e.g. {digest_preview}...)")
+        if on_disk is not None:
+            print(f"         matches committed {os.path.basename(on_disk)} "
+                  "— if the run is still LIVE (replicas are RAM-resident "
+                  "in its training processes; this catalog is the push-"
+                  "time advertisement, not a residency proof), a recovery "
+                  "at this step restores from peer RAM; after the "
+                  "processes exit, restores read storage")
+        else:
+            print(f"         no committed epoch_*_step_{step} on disk — "
+                  "STALE advertisement (superseded checkpoint or a dead "
+                  "run); restores ignore it and read storage")
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Validate checkpoint dirs against their manifests.")
@@ -58,6 +109,10 @@ def main(argv=None) -> int:
                         help="write a commit manifest for pre-protocol "
                         "(manifest-less) checkpoint dirs given as paths, "
                         "making them resumable — asserts they are complete")
+    parser.add_argument("--replicas", action="store_true",
+                        help="with --root, also report the advertised "
+                        "peer-replica catalogs (replica_catalog.p*.json) "
+                        "next to the on-disk manifests")
     args = parser.parse_args(argv)
 
     from automodel_tpu.checkpoint import checkpointing as ckpt
@@ -101,6 +156,11 @@ def main(argv=None) -> int:
                           "ignored by resume")
     if not targets:
         parser.error("give checkpoint paths or --root")
+
+    if args.replicas:
+        root = args.root or os.path.dirname(
+            os.path.normpath(targets[0])) or "."
+        _report_replicas(root)
 
     ok = True
     for path in targets:
