@@ -19,8 +19,9 @@ it drifts silently):
   different on every retrace — the classic irreproducible-run generator.
 * **L004** — host-sync calls (``jax.device_get``, ``.item()``,
   ``block_until_ready``, the ``float(m["loss"])`` metric-fetch idiom) in
-  hot-loop modules (``training/``, ``ops/``, ``generation/``, and the
-  ``_run_*`` bodies in ``recipes/``) outside an explicit suppression with
+  hot-loop modules (``training/``, ``ops/``, ``generation/``,
+  ``serving/``, and the ``_run_*`` bodies in ``recipes/``) outside an
+  explicit suppression with
   a one-line justification.  PR-2/5 earned the async hot loop; one stray
   fetch re-serializes it.
 * **L005** — ``fault_point("...")`` names must exist in
@@ -122,7 +123,7 @@ _SUPPRESS_RE = re.compile(
     r"#\s*lint:\s*disable=([A-Z0-9,\s]+?)\s*\(([^)]+)\)")
 
 _HOT_DIRS = ("automodel_tpu/training/", "automodel_tpu/ops/",
-             "automodel_tpu/generation/")
+             "automodel_tpu/generation/", "automodel_tpu/serving/")
 _RECIPES_DIR = "automodel_tpu/recipes/"
 _HOT_FUNC_RE = re.compile(r"^_run_")
 

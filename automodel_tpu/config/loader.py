@@ -363,6 +363,8 @@ def _enum_fields():
     from automodel_tpu.ops.moe import MOE_DISPATCHES
     from automodel_tpu.ops.quant import QUANT_DTYPES, QUANT_RECIPES
     from automodel_tpu.ops.zigzag import CP_LAYOUTS
+    from automodel_tpu.serving.kv_cache import KV_CACHE_DTYPES
+    from automodel_tpu.serving.scheduler import SCHEDULER_POLICIES
 
     return {
         "distributed.cp_layout": CP_LAYOUTS,
@@ -370,6 +372,8 @@ def _enum_fields():
         "kernels.autotune": AUTOTUNE_MODES,
         "fp8.dtype": QUANT_DTYPES,
         "fp8.recipe_name": QUANT_RECIPES,
+        "serving.kv_cache_dtype": KV_CACHE_DTYPES,
+        "serving.scheduler_policy": SCHEDULER_POLICIES,
     }
 
 

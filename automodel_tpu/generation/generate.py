@@ -17,6 +17,15 @@ by necessity: everything under jit, no data-dependent Python control flow.
 
 Two compiled programs total (prefill + decode step), reused across calls
 with the same bucket shapes.
+
+This is the EVAL path: one lockstep batch, dense per-request cache, every
+row padded to the longest prompt and resident until the slowest finishes.
+For batch > 1 serving workloads — mixed lengths, continuous arrivals,
+many concurrent requests — use the decode engine
+(``automodel_tpu/serving``, ``docs/guides/serving.md``): block-paged KV
+cache, chunked prefill, continuous batching, optional int8 KV — and
+token-identical greedy output to this function (the tier-1 parity
+oracle).
 """
 
 from __future__ import annotations

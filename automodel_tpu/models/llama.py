@@ -408,6 +408,19 @@ class LlamaForCausalLM:
         """Train/prefill/decode attention + cache update on rotated q/k."""
         S = q.shape[1]
         scale = self._attn_softmax_scale
+        if kv_cache is not None and hasattr(kv_cache, "layer_view"):
+            # Serving path: a block-paged cache view (duck-typed so models
+            # never import the serving layer — see
+            # ``serving/kv_cache.PagedKVView``).  Write this step's k/v
+            # into the per-layer pools at the view's slot mapping, then
+            # attend the paged history through the
+            # ``attention.paged_decode`` kernel chain; chunked prefill
+            # (S > 1) attends earlier chunks via the same block tables.
+            new_pools = kv_cache.write(k, v)
+            attn = kv_cache.attend(
+                q, new_pools, scale=scale,
+                local_window_size=local_window_size)
+            return attn, new_pools
         if kv_cache is not None:
             # Autoregressive decode: write this step's k/v into the static
             # [B, S_max, Hk, D] cache.  Prefill (S > 1) attends only over
@@ -591,9 +604,19 @@ class LlamaForCausalLM:
         layer_idx = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
 
         decoding = kv_cache is not None
+        # Paged serving cache: only the [L, ...] pools ride the layer scan's
+        # xs; the addressing arrays (block tables, slot mapping, context
+        # lengths) are layer-invariant and close over the scan body.  The
+        # returned "kv_cache" is then the stacked updated pools dict.
+        paged_view = kv_cache if (decoding
+                                  and hasattr(kv_cache, "layer_view")) \
+            else None
+        cache_xs = kv_cache.pools if paged_view is not None else kv_cache
 
         def one_layer(h, xs):
             layer_params, ad, idx, cache = xs
+            if paged_view is not None:
+                cache = paged_view.layer_view(cache)
             rng = (jax.random.fold_in(dropout_rng, idx)
                    if dropout_rng is not None else None)
             h, new_cache, aux = self._decoder_layer(
@@ -633,7 +656,7 @@ class LlamaForCausalLM:
             body = jax.checkpoint(
                 body, policy=resolve_remat_policy(self.remat_policy),
                 prevent_cse=False)
-        xs = (params["layers"], layer_adapters, layer_idx, kv_cache)
+        xs = (params["layers"], layer_adapters, layer_idx, cache_xs)
         if block > 1:
             xs = jax.tree.map(
                 lambda a: a.reshape(L // block, block, *a.shape[1:]), xs)

@@ -35,6 +35,7 @@ from automodel_tpu.ops.kernel_lib import registry
 # Rungs whose impl executes under JAX_PLATFORMS=cpu (+ interpret mode).
 CPU_EXECUTABLE = {
     "attention.splash", "attention.ring", "attention.sdpa",
+    "attention.paged_decode", "attention.paged_gather",
     "linear_ce.pallas", "linear_ce.chunked",
     "gmm.pallas", "gmm.xla_blocked", "gmm.ragged",
     "qdot.pallas", "qdot.xla",
@@ -46,6 +47,7 @@ _INTERPRET_MODULES = (
     "automodel_tpu.ops.linear_ce_kernel",
     "automodel_tpu.ops.gmm_kernel",
     "automodel_tpu.ops.qdot_kernel",
+    "automodel_tpu.ops.paged_attention_kernel",
 )
 
 
@@ -167,6 +169,90 @@ def run_attention_parity(spec_name: str, case: Dict,
     np.testing.assert_allclose(
         np.asarray(out, np.float32)[:, valid_rows],
         np.asarray(ref, np.float32)[:, valid_rows],
+        atol=tol, rtol=tol,
+        err_msg=f"{spec_name} diverged from its XLA reference on "
+                f"{case['name']}")
+
+
+# ---------------------------------------------------------------------------
+# paged attention family (the serving decode path)
+# ---------------------------------------------------------------------------
+def paged_attention_cases() -> List[Dict]:
+    """Decode (q=1) and chunked-prefill (q>1) traffic over scrambled block
+    tables with ragged per-row context lengths; the int8 cases exercise
+    the quantized-KV dequant inside each rung."""
+    return [
+        dict(name="decode_gqa", q_seq=1, dtype="float32"),
+        dict(name="decode_bf16", q_seq=1, dtype="bfloat16"),
+        dict(name="decode_int8_kv", q_seq=1, dtype="float32",
+             quantized=True),
+        dict(name="decode_window", q_seq=1, dtype="float32", window=24),
+        dict(name="decode_soft_cap", q_seq=1, dtype="float32",
+             soft_cap=30.0),
+        dict(name="chunked_prefill", q_seq=8, dtype="float32"),
+        dict(name="chunked_prefill_int8_kv", q_seq=8, dtype="float32",
+             quantized=True),
+    ]
+
+
+def build_paged_attention_case(case: Dict, *, B=2, Hq=4, Hk=2, D=128,
+                               BS=16, MB=4):
+    rng = np.random.default_rng(7)
+    dtype = jnp.dtype(case.get("dtype", "float32"))
+    S = case["q_seq"]
+    quantized = bool(case.get("quantized"))
+    NB = B * MB + 1
+    q = jnp.asarray(rng.normal(size=(B, S, Hq, D)), jnp.float32).astype(
+        dtype)
+    if quantized:
+        k_pool = jnp.asarray(
+            rng.integers(-127, 128, (NB, BS, Hk, D)), jnp.int8)
+        v_pool = jnp.asarray(
+            rng.integers(-127, 128, (NB, BS, Hk, D)), jnp.int8)
+        k_scale = jnp.asarray(
+            rng.uniform(0.005, 0.02, (NB, BS, Hk)), jnp.float32)
+        v_scale = jnp.asarray(
+            rng.uniform(0.005, 0.02, (NB, BS, Hk)), jnp.float32)
+    else:
+        k_pool = jnp.asarray(rng.normal(size=(NB, BS, Hk, D)),
+                             jnp.float32).astype(dtype)
+        v_pool = jnp.asarray(rng.normal(size=(NB, BS, Hk, D)),
+                             jnp.float32).astype(dtype)
+        k_scale = v_scale = None
+    # scrambled, per-row-disjoint block tables (block 0 = null page)
+    perm = rng.permutation(np.arange(1, NB)).reshape(B, MB)
+    block_tables = jnp.asarray(perm, jnp.int32)
+    ctx = np.asarray([MB * BS - 7, 2 * BS + 3][:B], np.int32)
+    ctx = np.maximum(ctx, S)
+    positions = jnp.asarray(
+        ctx[:, None] - S + np.arange(S)[None, :], jnp.int32)
+    kwargs: Dict = {}
+    if case.get("soft_cap"):
+        kwargs["logits_soft_cap"] = float(case["soft_cap"])
+    if case.get("window"):
+        kwargs["local_window_size"] = int(case["window"])
+    from automodel_tpu.ops.paged_attention import build_paged_request
+
+    request = build_paged_request(
+        q, k_pool, quantized=quantized,
+        soft_cap="logits_soft_cap" in kwargs,
+        window="local_window_size" in kwargs)
+    return (q, k_pool, v_pool, k_scale, v_scale, block_tables,
+            jnp.asarray(ctx), positions), kwargs, request
+
+
+def run_paged_attention_parity(spec_name: str, case: Dict) -> None:
+    spec = registry.get_kernel(spec_name)
+    assert spec.reference is not None, f"{spec_name} has no XLA reference"
+    if spec_name == "attention.paged_decode" and case["q_seq"] != 1:
+        return      # that rung's contract is single-token decode queries
+    args, kwargs, request = build_paged_attention_case(case)
+    with interpret_mode():
+        out = spec.impl(request, *args, **kwargs)
+    ref = spec.reference(request, *args, **kwargs)
+    tol = 2e-2 if case.get("dtype") == "bfloat16" else 2e-3
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
         atol=tol, rtol=tol,
         err_msg=f"{spec_name} diverged from its XLA reference on "
                 f"{case['name']}")

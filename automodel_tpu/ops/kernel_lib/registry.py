@@ -146,6 +146,9 @@ _DEFAULT_KERNEL_MODULES = (
     ("automodel_tpu.ops.flash_attention", "attention.flash",
      "attention.sdpa"),
     ("automodel_tpu.ops.attention", "attention.sdpa", None),
+    ("automodel_tpu.ops.paged_attention_kernel", "attention.paged_decode",
+     "attention.paged_gather"),
+    ("automodel_tpu.ops.paged_attention", "attention.paged_gather", None),
     ("automodel_tpu.ops.linear_ce_kernel", "linear_ce.pallas",
      "linear_ce.chunked"),
     ("automodel_tpu.loss.linear_ce", "linear_ce.chunked", None),

@@ -1,0 +1,175 @@
+"""Paged attention over a block-paged KV cache — the serving decode path.
+
+The serving engine (``automodel_tpu/serving``) keeps every request's KV
+history in fixed-size *blocks* of a static ``[num_blocks, block_size, Hk,
+D]`` pool; a per-request *block table* names which pool blocks hold its
+positions ``0..context_len-1`` (position ``p`` lives in slot ``p %
+block_size`` of block ``table[p // block_size]``).  Attention over that
+layout is its own kernel family on the PR-7 substrate:
+
+* ``attention.paged_decode`` — Pallas gather-by-block-table online-softmax
+  decode (``ops/paged_attention_kernel.py``): the block table rides scalar
+  prefetch so BlockSpec index maps DMA exactly the pages a row owns, with
+  wholly-past-the-context pages skipped.  Single-token queries (the decode
+  hot path).
+* ``attention.paged_gather`` — the XLA anchor registered HERE: gather the
+  pool by block table, mask by per-token positions + context lengths, SDPA.
+  Always available (CPU test path, chunked-prefill queries of any length,
+  GSPMD-correct), and structurally distinct from the parity harness's
+  ``reference`` (dense per-row reconstruction + vmapped
+  ``dot_product_attention``), so the two can actually disagree.
+
+Both rungs speak one request/operand contract (:func:`paged_attention`):
+
+* ``q [B, S, Hq, D]`` — per-row query tokens at CONSECUTIVE positions
+  ``positions[b, t]`` (pad columns repeat the last valid position and are
+  discarded by the caller);
+* ``k_pool / v_pool [NB, BS, Hk, D]`` — position-major pools, optionally
+  int8 with per-slot-per-head scale planes ``[NB, BS, Hk]`` (the
+  quantized KV cache, see ``serving/kv_cache.py``);
+* ``block_tables [B, MB]`` int32, ``context_lens [B]`` int32 (valid
+  positions INCLUDING tokens written this step).  Rows must satisfy
+  ``context_lens >= 1`` and ``positions >= 0`` so every query has at least
+  one attendable key (softmax never sees an all-masked row).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.ops.kernel_lib import registry
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def dequantize_pool(pool: jnp.ndarray, scale: Optional[jnp.ndarray],
+                    dtype=jnp.float32) -> jnp.ndarray:
+    """int8 pool [..., Hk, D] * per-slot scale [..., Hk] -> compute dtype;
+    non-quantized pools pass through (cast only)."""
+    if scale is None:
+        return pool.astype(dtype)
+    return pool.astype(jnp.float32) * scale[..., None].astype(jnp.float32)
+
+
+def gathered_cache(pool: jnp.ndarray, scale: Optional[jnp.ndarray],
+                   block_tables: jnp.ndarray, dtype=jnp.float32):
+    """Linearize a row's pool blocks by position: ``[B, MB*BS, Hk, D]``.
+
+    Because block tables are position-major (position ``p`` -> slot ``p %
+    BS`` of ``table[p // BS]``), gathering blocks in table order IS the
+    dense per-row cache reconstruction.
+    """
+    g = pool[block_tables]                       # [B, MB, BS, Hk, D]
+    gs = scale[block_tables] if scale is not None else None
+    B, MB, BS = g.shape[:3]
+    g = dequantize_pool(g, gs, dtype).reshape(B, MB * BS, *g.shape[3:])
+    return g
+
+
+def _paged_gather_impl(request, q, k_pool, v_pool, k_scale, v_scale,
+                       block_tables, context_lens, positions, *,
+                       scale=None, logits_soft_cap=None,
+                       local_window_size=None):
+    """XLA anchor: gather-by-table + masked SDPA, any query length."""
+    B, S, Hq, D = q.shape
+    Hk = k_pool.shape[2]
+    assert Hq % Hk == 0, f"query heads {Hq} not a multiple of kv heads {Hk}"
+    G = Hq // Hk
+    scale = D ** -0.5 if scale is None else scale
+
+    keys = gathered_cache(k_pool, k_scale, block_tables)    # [B, K, Hk, D]
+    vals = gathered_cache(v_pool, v_scale, block_tables)
+    K = keys.shape[1]
+
+    qg = q.reshape(B, S, Hk, G, D)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
+                        keys, precision=jax.lax.Precision.DEFAULT) * scale
+    if logits_soft_cap is not None:
+        logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+
+    kv_pos = jnp.arange(K, dtype=jnp.int32)
+    valid = kv_pos[None, None, :] < context_lens[:, None, None]   # [B, 1, K]
+    causal = positions[:, :, None] >= kv_pos[None, None, :]       # [B, S, K]
+    mask = valid & causal
+    if local_window_size is not None:
+        mask &= positions[:, :, None] - kv_pos[None, None, :] \
+            < local_window_size
+    logits = jnp.where(mask[:, None, None], logits, _NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(vals.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vals)
+    return out.reshape(B, S, Hq, D).astype(q.dtype)
+
+
+def paged_reference(request, q, k_pool, v_pool, k_scale, v_scale,
+                    block_tables, context_lens, positions, *,
+                    scale=None, logits_soft_cap=None,
+                    local_window_size=None):
+    """The family's parity oracle: dense per-row cache reconstruction +
+    vmapped :func:`~automodel_tpu.ops.attention.dot_product_attention` with
+    each row's first query position as ``q_offset`` (queries are
+    consecutive by contract) and the context length as a padding mask —
+    i.e. exactly what the dense ``generate()`` cache path would compute on
+    the same numbers."""
+    from automodel_tpu.ops.attention import dot_product_attention
+
+    keys = gathered_cache(k_pool, k_scale, block_tables)
+    vals = gathered_cache(v_pool, v_scale, block_tables)
+    K = keys.shape[1]
+
+    def row(qb, kb, vb, ctx, pos0):
+        am = (jnp.arange(K, dtype=jnp.int32) < ctx)[None]   # [1, K]
+        return dot_product_attention(
+            qb[None], kb[None], vb[None], causal=True, q_offset=pos0,
+            attention_mask=am, scale=scale,
+            logits_soft_cap=logits_soft_cap,
+            local_window_size=local_window_size)[0]
+
+    out = jax.vmap(row)(q.astype(jnp.float32), keys, vals, context_lens,
+                        positions[:, 0])
+    return out.astype(q.dtype)
+
+
+def build_paged_request(q, k_pool, *, quantized: bool,
+                        soft_cap: bool = False,
+                        window: bool = False) -> Dict[str, Any]:
+    """The plain-dict request the ``attention.paged_decode`` chain's probes
+    answer from (static shapes + feature flags only)."""
+    return {
+        "kind": "paged_attention",
+        "q_seq": q.shape[1], "head_dim": q.shape[3],
+        "num_q_heads": q.shape[2], "num_kv_heads": k_pool.shape[2],
+        "num_blocks": k_pool.shape[0], "block_size": k_pool.shape[1],
+        "dtype": str(q.dtype), "quantized": bool(quantized),
+        "soft_cap": bool(soft_cap), "window": bool(window),
+    }
+
+
+def paged_attention(q, k_pool, v_pool, *, block_tables, context_lens,
+                    positions, k_scale=None, v_scale=None, scale=None,
+                    logits_soft_cap=None, local_window_size=None):
+    """The serving path's attention entry point: build one request and
+    resolve the ``attention.paged_decode -> attention.paged_gather`` chain
+    (see module docstring for the operand contract)."""
+    request = build_paged_request(
+        q, k_pool, quantized=k_scale is not None,
+        soft_cap=logits_soft_cap is not None,
+        window=local_window_size is not None)
+    spec = registry.resolve("attention.paged_decode", request)
+    return spec.impl(
+        request, q, k_pool, v_pool, k_scale, v_scale, block_tables,
+        context_lens, positions, scale=scale,
+        logits_soft_cap=logits_soft_cap,
+        local_window_size=local_window_size)
+
+
+def _paged_gather_probe(request: Mapping[str, Any]) -> bool:
+    return True          # the chain's always-available anchor
+
+
+registry.register_kernel(
+    "attention.paged_gather", probe=_paged_gather_probe,
+    impl=_paged_gather_impl, fallback=None, reference=paged_reference)

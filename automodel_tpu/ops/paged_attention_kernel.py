@@ -1,0 +1,299 @@
+"""Pallas paged-decode attention — the ``attention.paged_decode`` rung.
+
+Single-token decode over the serving engine's block-paged KV cache
+(``ops/paged_attention.py`` owns the family contract).  The per-request
+block tables ride SCALAR PREFETCH, so each grid step's BlockSpec index map
+steers the DMA at exactly the pool page a row owns for that position range
+— the grouped-matmul schedule pattern (``ops/gmm_kernel.py``) applied to
+attention.  Per (row, kv-head tile) the kernel walks the row's pages with
+a flash-style online softmax in VMEM scratch; pages wholly past the row's
+context length are compute-skipped (their DMA fetches the engine's null
+page 0, which every pad table entry points at).
+
+Decode queries are single tokens at position ``context_len - 1``, so the
+causal constraint degenerates to the context-length mask — the kernel
+needs no position operand at all.
+
+Quantized (int8) pools dequantize IN VMEM with the per-slot scale planes
+(PR-10's ``quant_cast`` contract inverted), so the HBM traffic — the thing
+decode is bound by — is 1 byte per cached element instead of 2.
+
+Autotune (key ``"paged_decode"``): the kv-head tile ``kt`` — how many kv
+heads (with their ``G`` query heads each) one grid step processes.  Larger
+tiles amortize grid/DMA overhead, smaller ones bound the VMEM working set;
+candidates are the divisors of ``Hk`` that fit the shared byte model.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.ops.kernel_lib import autotune, registry, tiling
+from automodel_tpu.ops.paged_attention import paged_reference
+
+# Pallas interpret mode: lets the CPU test suite execute the real kernel
+# logic (tests monkeypatch this, mirroring ops/gmm_kernel.py).
+_INTERPRET = False
+
+_LANE = tiling.LANE
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def paged_decode_available(q_seq: int, head_dim: int) -> bool:
+    """Kernel path requires single-token queries (the decode contract: the
+    causal mask degenerates to the context mask), a lane-aligned head dim,
+    and TPU (or interpret mode)."""
+    if q_seq != 1 or head_dim % _LANE:
+        return False
+    if _INTERPRET:
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _tile_bytes(kt: int, g: int, bs: int, d: int, kv_itemsize: int,
+                quantized: bool) -> int:
+    """VMEM working set of one (row, kv-head-tile) grid step: the
+    double-buffered k/v page blocks (+ int8 scale planes), the resident q
+    block, and the fp32 online-softmax scratch.  ONE byte model — shared
+    by the runtime default/validate AND the sweep's candidate filter."""
+    pages = 2 * 2 * bs * kt * d * kv_itemsize          # k+v double-buffered
+    if quantized:
+        pages += 2 * 2 * bs * kt * 4                   # scale planes
+    q = kt * g * d * 4
+    scratch = kt * g * d * 4 + 2 * kt * g * 128 * 4    # acc + m/l
+    return pages + q + scratch
+
+
+def _head_tile(hk: int, g: int, bs: int, d: int, kv_itemsize: int,
+               quantized: bool, pages: int, dtype: str) -> int:
+    """kv-head tile via divisor search under the VMEM budget, overridden
+    by a persisted autotune winner (kernel key ``"paged_decode"``)."""
+    budget = tiling.DEFAULT_TILE_BUDGET_BYTES
+
+    def fits(kt: int) -> bool:
+        return _tile_bytes(kt, g, bs, d, kv_itemsize, quantized) <= budget
+
+    divisors = [kt for kt in range(hk, 0, -1) if hk % kt == 0]
+    default = next((kt for kt in divisors if fits(kt)), 1)
+    fields = {"hk": hk, "g": g, "bs": bs, "d": d,
+              "pages": autotune.shape_bucket(pages), "dtype": dtype,
+              "quant": quantized}
+    choice = autotune.lookup(
+        "paged_decode", fields, (default,),
+        validate=lambda c: (len(c) == 1 and c[0] >= 1 and hk % c[0] == 0
+                            and fits(c[0])))
+    return int(choice[0])
+
+
+def _decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                   o_ref, m_ref, l_ref, acc_ref, *, bs, kt, g, scale,
+                   soft_cap, window, quantized):
+    from jax.experimental import pallas as pl
+
+    b, j = pl.program_id(0), pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    ctx = cl_ref[b]
+
+    @pl.when(j * bs < ctx)
+    def _compute():
+        def page(ref, s_ref):
+            x = ref[0].astype(jnp.float32)          # (BS, kt, D)
+            if quantized:
+                x = x * s_ref[0].astype(jnp.float32)[..., None]
+            return jnp.swapaxes(x, 0, 1)            # (kt, BS, D)
+
+        q = q_ref[0].astype(jnp.float32)            # (kt, G, D)
+        k = page(k_ref, ks_ref)
+        # (kt, G, D) x (kt, BS, D) -> (kt, G, BS), kv heads batched
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
+        if soft_cap is not None:
+            s = soft_cap * jnp.tanh(s / soft_cap)
+        kv_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (kt, g, bs), 2)
+        valid = kv_pos < ctx
+        if window is not None:
+            # decode query position == ctx - 1
+            valid &= kv_pos > ctx - 1 - window
+        s = jnp.where(valid, s, _NEG_INF)
+
+        s2 = s.reshape(kt * g, bs)
+        m_prev = m_ref[:, :1]
+        m_b = jnp.max(s2, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_b)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s2 - m_new)
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+
+        v = page(v_ref, vs_ref)                     # (kt, BS, D)
+        o_b = jax.lax.dot_general(
+            p.reshape(kt, g, bs), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)     # (kt, G, D)
+        acc_ref[...] = acc_ref[...] * alpha + o_b.reshape(kt * g, -1)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_ref[...] / l).reshape(o_ref.shape).astype(
+            o_ref.dtype)
+
+
+def paged_decode_pallas(q, k_pool, v_pool, k_scale, v_scale, block_tables,
+                        context_lens, *, scale=None, logits_soft_cap=None,
+                        local_window_size=None):
+    """``q [B, 1, Hq, D]`` over position-major pools ``[NB, BS, Hk, D]``
+    (+ optional int8 scale planes ``[NB, BS, Hk]``) -> ``[B, 1, Hq, D]``."""
+    from jax.experimental import pallas as pl
+
+    B, S, Hq, D = q.shape
+    NB, BS, Hk, _ = k_pool.shape
+    MB = block_tables.shape[1]
+    assert S == 1, "paged_decode is the single-token decode rung"
+    G = Hq // Hk
+    scale = D ** -0.5 if scale is None else scale
+    quantized = k_scale is not None
+    kt = _head_tile(Hk, G, BS, D, k_pool.dtype.itemsize, quantized, MB,
+                    str(q.dtype))
+
+    q4 = q.reshape(B, Hk, G, D)
+    if not quantized:
+        # uniform kernel signature: zero-page dummies the specs still index
+        k_scale = jnp.ones((1, BS, Hk), jnp.float32)
+        v_scale = jnp.ones((1, BS, Hk), jnp.float32)
+
+    def page_index(b, h, j, bt, cl):
+        return (bt[b, j], 0, h, 0)
+
+    def scale_index(b, h, j, bt, cl):
+        if quantized:
+            return (bt[b, j], 0, h)
+        return (0, 0, h)
+
+    def q_index(b, h, j, bt, cl):
+        return (b, h, 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, bs=BS, kt=kt, g=G, scale=scale,
+            soft_cap=logits_soft_cap, window=local_window_size,
+            quantized=quantized),
+        grid_spec=tiling.prefetch_grid_spec(
+            num_scalar_prefetch=2,
+            grid=(B, Hk // kt, MB),
+            in_specs=[
+                tiling.block_spec((1, kt, G, D), q_index),
+                tiling.block_spec((1, BS, kt, D), page_index),
+                tiling.block_spec((1, BS, kt, D), page_index),
+                tiling.block_spec((1, BS, kt), scale_index),
+                tiling.block_spec((1, BS, kt), scale_index),
+            ],
+            out_specs=tiling.block_spec((1, kt, G, D), q_index),
+            scratch_shapes=[
+                _scratch((kt * G, 128), jnp.float32),
+                _scratch((kt * G, 128), jnp.float32),
+                _scratch((kt * G, D), jnp.float32),
+            ]),
+        out_shape=jax.ShapeDtypeStruct((B, Hk, G, D), q.dtype),
+        compiler_params=tiling.compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_INTERPRET,
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+      q4, k_pool, v_pool, k_scale, v_scale)
+    return out.reshape(B, 1, Hq, D)
+
+
+def _scratch(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Registry rung + autotune adapter
+# ---------------------------------------------------------------------------
+def _paged_decode_probe(request) -> bool:
+    return paged_decode_available(request["q_seq"], request["head_dim"])
+
+
+def _paged_decode_impl(request, q, k_pool, v_pool, k_scale, v_scale,
+                       block_tables, context_lens, positions, *,
+                       scale=None, logits_soft_cap=None,
+                       local_window_size=None):
+    # positions are implied by the decode contract (ctx - 1); the family
+    # entry passes them for the gather rung's benefit.
+    del positions
+    return paged_decode_pallas(
+        q, k_pool, v_pool, k_scale, v_scale, block_tables, context_lens,
+        scale=scale, logits_soft_cap=logits_soft_cap,
+        local_window_size=local_window_size)
+
+
+def _sweep_key_fields(req):
+    g = req["num_q_heads"] // req["num_kv_heads"]
+    return {"hk": req["num_kv_heads"], "g": g, "bs": req["block_size"],
+            "d": req["head_dim"],
+            "pages": autotune.shape_bucket(req["pages_per_seq"]),
+            "dtype": str(req.get("dtype", "bfloat16")),
+            "quant": bool(req.get("quantized"))}
+
+
+def _sweep_candidates(req):
+    hk, d, bs = req["num_kv_heads"], req["head_dim"], req["block_size"]
+    g = req["num_q_heads"] // hk
+    item = 1 if req.get("quantized") else 2
+    return [(kt,) for kt in range(hk, 0, -1)
+            if hk % kt == 0
+            and _tile_bytes(kt, g, bs, d, item, bool(req.get("quantized")))
+            <= tiling.DEFAULT_TILE_BUDGET_BYTES]
+
+
+def _sweep_run(req, choice) -> float:
+    hk, d, bs = req["num_kv_heads"], req["head_dim"], req["block_size"]
+    hq, mb = req["num_q_heads"], req["pages_per_seq"]
+    b = int(req.get("batch", 8))
+    nb = b * mb + 1
+    quant = bool(req.get("quantized"))
+    key = jax.random.key(0)
+    dtype = jnp.dtype(req.get("dtype", "bfloat16"))
+    q = jax.random.normal(key, (b, 1, hq, d), jnp.float32).astype(dtype)
+    if quant:
+        kp = jax.random.randint(key, (nb, bs, hk, d), -127, 128, jnp.int8)
+        vp = kp
+        ks = jnp.full((nb, bs, hk), 0.01, jnp.float32)
+        vs = ks
+    else:
+        kp = jax.random.normal(key, (nb, bs, hk, d), jnp.float32).astype(
+            dtype)
+        vp = kp
+        ks = vs = None
+    tables = jnp.arange(1, 1 + b * mb, dtype=jnp.int32).reshape(b, mb)
+    ctx = jnp.full((b,), mb * bs, jnp.int32)
+
+    fn = jax.jit(functools.partial(paged_decode_pallas, scale=None))
+    return autotune.time_call(fn, q, kp, vp, ks, vs, tables, ctx)
+
+
+registry.register_kernel(
+    "attention.paged_decode", probe=_paged_decode_probe,
+    impl=_paged_decode_impl, fallback="attention.paged_gather",
+    reference=paged_reference)
+autotune.register_sweep(
+    "paged_decode", key_fields=_sweep_key_fields,
+    candidates=_sweep_candidates, run=_sweep_run)

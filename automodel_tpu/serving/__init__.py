@@ -1,0 +1,32 @@
+"""Serving-grade decode engine: continuous batching over a block-paged,
+quantizable KV cache (see ``docs/guides/serving.md``).
+
+Layout::
+
+    serving/
+      kv_cache.py   block pools + allocator + the PagedKVView pytree
+      scheduler.py  per-request state machine, chunked prefill, preemption
+      engine.py     static-shape jitted steps + the host decode loop
+      eval.py       online-eval consumer (greedy scoring via the engine)
+
+The paged attention kernels live on the PR-7 substrate in
+``ops/paged_attention.py`` / ``ops/paged_attention_kernel.py``.
+"""
+
+from automodel_tpu.serving.engine import (          # noqa: F401
+    DecodeEngine,
+    ServingConfig,
+    build_serving_config,
+)
+from automodel_tpu.serving.kv_cache import (        # noqa: F401
+    KV_CACHE_DTYPES,
+    BlockAllocator,
+    OutOfBlocks,
+    PagedKVView,
+)
+from automodel_tpu.serving.scheduler import (       # noqa: F401
+    SCHEDULER_POLICIES,
+    Request,
+    RequestState,
+    Scheduler,
+)

@@ -1,0 +1,351 @@
+"""The decode engine: continuous batching over the block-paged KV cache.
+
+``generation/generate.py`` is a fixed-batch prefill-then-scan loop — every
+row starts together, pads to the longest prompt, and the whole batch holds
+its HBM until the slowest row finishes.  A serving workload needs the
+opposite: requests arrive and finish continuously, and the engine must
+keep the chip busy without ever recompiling.  :class:`DecodeEngine` does
+that with three static-shape ingredients:
+
+* **step buffers** — every device step is ``[max_num_seqs, W]`` where the
+  width ``W`` is 1 (pure decode) or ``prefill_chunk`` (a step carrying any
+  prefill work; decode rows ride along with one valid token).  One jitted
+  program per width, compiled once — admissions, finishes, preemptions and
+  aborts only change the *contents* of the buffers (the tier-1 suite holds
+  ``assert_compiles_once`` across a multi-request run);
+* **the paged KV cache** (``serving/kv_cache.py``) — pools donated through
+  the step so cache updates are in-place, block tables assembled host-side
+  from the scheduler's plan;
+* **the scheduler** (``serving/scheduler.py``) — WAITING → PREFILL →
+  DECODE → FINISHED per request, chunked prefill sharing step slots with
+  decode, in-flight admission when blocks free up, and recompute
+  preemption under KV pressure (drilled by the ``serve_block_alloc`` fault
+  point; mid-flight cancels by ``serve_request_abort``).
+
+Greedy sampling runs on-device inside the step (one ``[B]`` token fetch
+per step is the engine's only host sync); ``do_sample`` configs sample
+host-side from the returned last-token logits.  Greedy output is
+token-identical to ``generate()`` on the same model/params — the tier-1
+parity oracle (``tests/unit_tests/test_serving.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import itertools
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.generation.generate import GenerationConfig, sample_logits
+from automodel_tpu.serving.kv_cache import (
+    DEFAULT_KV_CACHE_DTYPE,
+    BlockAllocator,
+    PagedKVView,
+    blocks_needed,
+    init_paged_pools,
+    normalize_kv_cache_dtype,
+    pool_bytes,
+    slot_for,
+    validate_kv_cache_dtype,
+)
+from automodel_tpu.serving.scheduler import (
+    DEFAULT_SCHEDULER_POLICY,
+    Request,
+    RequestState,
+    Scheduler,
+    StepPlan,
+    normalize_scheduler_policy,
+    validate_scheduler_policy,
+)
+from automodel_tpu.utils.fault_injection import InjectedFault, fault_point
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """The ``serving:`` YAML section (every enum re-validated here so
+    programmatic construction fails exactly like a typo'd YAML —
+    the L002 contract)."""
+
+    kv_block_size: int = 16
+    kv_cache_dtype: Optional[str] = None     # None/"auto" -> compute dtype
+    max_num_seqs: int = 8
+    max_model_len: int = 1024
+    num_kv_blocks: Optional[int] = None      # None -> full residency + null
+    prefill_chunk: int = 32
+    scheduler_policy: Optional[str] = None   # None -> fcfs
+
+    def __post_init__(self):
+        for field in ("kv_block_size", "max_num_seqs", "max_model_len",
+                      "prefill_chunk"):
+            v = getattr(self, field)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(
+                    f"serving.{field} must be a positive int, got {v!r}")
+        if self.num_kv_blocks is not None and self.num_kv_blocks < 2:
+            raise ValueError(
+                "serving.num_kv_blocks must be >= 2 (1 null + 1 usable), "
+                f"got {self.num_kv_blocks!r}")
+        self.kv_cache_dtype = validate_kv_cache_dtype(
+            normalize_kv_cache_dtype(self.kv_cache_dtype))
+        self.scheduler_policy = validate_scheduler_policy(
+            normalize_scheduler_policy(self.scheduler_policy))
+
+    @property
+    def blocks_per_seq(self) -> int:
+        return blocks_needed(self.max_model_len, self.kv_block_size)
+
+    def resolved_num_blocks(self) -> int:
+        if self.num_kv_blocks is not None:
+            return self.num_kv_blocks
+        return self.max_num_seqs * self.blocks_per_seq + 1
+
+
+def build_serving_config(cfg: Any) -> ServingConfig:
+    """``ServingConfig`` from a loaded YAML's ``serving:`` node (or a plain
+    dict / None for the defaults)."""
+    if cfg is None:
+        return ServingConfig()
+    if hasattr(cfg, "get") and hasattr(cfg, "to_dict"):   # ConfigNode
+        node = cfg.get("serving", cfg)
+        data = node.to_dict() if hasattr(node, "to_dict") else dict(node)
+    else:
+        data = dict(cfg)
+    known = {f.name for f in dataclasses.fields(ServingConfig)}
+    unknown = sorted(set(data) - known)
+    if unknown:
+        raise ValueError(
+            f"unknown serving config key(s) {unknown}; known: "
+            f"{sorted(known)}")
+    return ServingConfig(**data)
+
+
+def _paged_step(model, block_size: int, quantized: bool, params, pools,
+                input_ids, positions, slot_mapping, block_tables,
+                context_lens, last_col):
+    """ONE traced program per step width: write this step's tokens into
+    the paged cache, attend, and greedy-pick each row's next token at its
+    last valid column.  Returns ``(greedy [B], last_logits [B, V],
+    pools)`` — pools donated, so the cache updates in place."""
+    view = PagedKVView(
+        pools, block_tables, slot_mapping, context_lens, positions,
+        block_size=block_size, quantized=quantized)
+    out = model(params, input_ids, position_ids=positions, kv_cache=view)
+    logits = out["logits"].astype(jnp.float32)                # [B, W, V]
+    last = jnp.take_along_axis(
+        logits, last_col[:, None, None], axis=1)[:, 0]        # [B, V]
+    greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    return greedy, last, out["kv_cache"]
+
+
+class DecodeEngine:
+    """Continuous-batching paged-KV decode over one model + params."""
+
+    def __init__(self, model, params, config: Optional[ServingConfig] = None,
+                 generation: Optional[GenerationConfig] = None):
+        self.model = model
+        self.params = params
+        self.config = config or ServingConfig()
+        self.generation = generation or GenerationConfig()
+        mcfg = model.config
+        dtype = self.config.kv_cache_dtype or DEFAULT_KV_CACHE_DTYPE
+        self.quantized = dtype == "int8"
+        cache_dtype = jnp.int8 if self.quantized else model.compute_dtype
+        num_blocks = self.config.resolved_num_blocks()
+        self.max_blocks_per_seq = self.config.blocks_per_seq
+        self.pools = init_paged_pools(
+            num_layers=mcfg.num_hidden_layers,
+            num_kv_heads=mcfg.num_key_value_heads,
+            head_dim=mcfg.head_dim, num_blocks=num_blocks,
+            block_size=self.config.kv_block_size, cache_dtype=cache_dtype,
+            quantized=self.quantized)
+        self.allocator = BlockAllocator(num_blocks)
+        self.scheduler = Scheduler(
+            self.allocator, max_num_seqs=self.config.max_num_seqs,
+            prefill_chunk=self.config.prefill_chunk,
+            block_size=self.config.kv_block_size,
+            max_model_len=self.config.max_model_len,
+            policy=self.config.scheduler_policy
+            or DEFAULT_SCHEDULER_POLICY)
+        self.requests: Dict[int, Request] = {}
+        self._rids = itertools.count()
+        self._steps: Dict[int, Any] = {}       # width -> jitted step
+        self._sample_key = jax.random.key(0)
+        self.steps_run = 0
+        self.decode_steps = 0
+        self.mixed_steps = 0
+        self.aborts = 0
+        self.tokens_generated = 0
+
+    # -- compiled step per width (the "compiles once per bucket" seam) -----
+    def step_fn(self, width: int):
+        fn = self._steps.get(width)
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(_paged_step, self.model,
+                                  self.config.kv_block_size, self.quantized),
+                donate_argnums=(1,))
+            self._steps[width] = fn
+        return fn
+
+    # -- request intake ----------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = "default") -> int:
+        """Queue one request; returns its id.  ``eos_token_id`` defaults to
+        the engine's :class:`GenerationConfig` (pass None to disable)."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("cannot serve an empty prompt")
+        if eos_token_id == "default":
+            eos_token_id = self.generation.eos_token_id
+        rid = next(self._rids)
+        req = Request(
+            rid=rid, prompt=prompt,
+            max_new_tokens=(self.generation.max_new_tokens
+                            if max_new_tokens is None else max_new_tokens),
+            eos_token_id=eos_token_id)
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.scheduler.add(req)
+        self.requests[rid] = req
+        return rid
+
+    def abort(self, rid: int) -> None:
+        """Cancel a request anywhere in its lifecycle; its block table is
+        freed immediately (the ``serve_request_abort`` contract)."""
+        req = self.requests.get(rid)
+        if req is None or req.finished:
+            return
+        self.scheduler.abort(req)
+        self.aborts += 1
+
+    # -- the engine loop ---------------------------------------------------
+    def _assemble(self, plan: StepPlan):
+        cfg = self.config
+        B, W, MB = cfg.max_num_seqs, plan.step_width, self.max_blocks_per_seq
+        bs = cfg.kv_block_size
+        ids = np.zeros((B, W), np.int32)
+        pos = np.zeros((B, W), np.int32)
+        # pad/idle tokens write into the null page (block 0), slot col % bs
+        slots = np.tile(np.arange(W, dtype=np.int32) % bs, (B, 1))
+        tables = np.zeros((B, MB), np.int32)
+        ctx = np.ones((B,), np.int32)       # idle rows: 1 (null-page key 0)
+        last = np.zeros((B,), np.int32)
+        for work in plan.active:
+            b, t = work.req.slot, len(work.tokens)
+            start = work.start_pos
+            ids[b, :t] = work.tokens
+            pos[b, :t] = np.arange(start, start + t)
+            pos[b, t:] = start + t - 1      # pads clamp to the last valid
+            blocks = work.req.blocks
+            tables[b, :len(blocks)] = blocks
+            slots[b, :t] = [slot_for(blocks, p, bs)
+                            for p in range(start, start + t)]
+            ctx[b] = start + t
+            last[b] = t - 1
+        return ids, pos, slots, tables, ctx, last
+
+    def _sample(self, row: int, greedy: np.ndarray,
+                last_logits) -> np.ndarray:
+        if not self.generation.do_sample:
+            return greedy[row]
+        # host-side sampling path: one extra [V] fetch per sampled row
+        key = jax.random.fold_in(self._sample_key, self.steps_run * 4096
+                                 + row)
+        return int(np.asarray(sample_logits(
+            jnp.asarray(last_logits[row])[None], self.generation, key))[0])
+
+    def step(self) -> List[Request]:
+        """One scheduler + device step; returns the requests that finished
+        on it.  No-op (empty list) when idle."""
+        # The drilled mid-decode cancel: an armed ``serve_request_abort``
+        # models a client disconnect — the oldest active request is aborted
+        # and its block table freed before the step runs.
+        try:
+            fault_point("serve_request_abort")
+        except InjectedFault:
+            active = self.scheduler.active
+            if active:
+                self.abort(min(active, key=lambda r: r.arrival).rid)
+        plan = self.scheduler.schedule()
+        if plan is None:
+            return []
+        ids, pos, slots, tables, ctx, last = self._assemble(plan)
+        greedy, last_logits, self.pools = self.step_fn(plan.step_width)(
+            self.params, self.pools, ids, pos, slots, tables, ctx, last)
+        # the engine's one host sync: the [B] sampled tokens drive the
+        # host-side request state machine
+        greedy = np.asarray(jax.device_get(greedy))  # lint: disable=L004 (continuous batching IS a per-step host decision loop: one [B]-int fetch per step, the logits stay on device unless do_sample)
+        sampled = {w.req.slot: self._sample(w.req.slot, greedy, last_logits)
+                   for w in plan.active if w.samples_next}
+        self.steps_run += 1
+        if plan.step_width == 1:
+            self.decode_steps += 1
+        else:
+            self.mixed_steps += 1
+        done = self.scheduler.finish_step(plan, sampled)
+        self.tokens_generated += len(sampled)
+        return done
+
+    def run(self, max_steps: Optional[int] = None) -> Dict[int, List[int]]:
+        """Drive until every submitted request finishes; returns rid ->
+        generated tokens.  ``max_steps`` (default: a generous work bound)
+        turns a scheduler bug into a loud error instead of a hang."""
+        if max_steps is None:
+            budget = sum(
+                blocks_needed(len(r.prompt), self.config.prefill_chunk)
+                + r.max_new_tokens + 1
+                for r in self.requests.values() if not r.finished)
+            max_steps = 64 + 8 * budget
+        steps = 0
+        while self.scheduler.has_work():
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"engine made no progress within {max_steps} steps — "
+                    "scheduler stall (file a bug with the request trace)")
+        return {rid: list(r.out_tokens) for rid, r in self.requests.items()}
+
+    # -- the generate()-shaped oracle entry --------------------------------
+    def generate(self, input_ids, prompt_lens=None,
+                 config: Optional[GenerationConfig] = None) -> np.ndarray:
+        """Drop-in for :func:`automodel_tpu.generation.generate`:
+        right-padded ``[B, S]`` prompts -> ``[B, max_new_tokens]`` int32
+        with ``pad_token_id`` after eos — the tier-1 parity oracle drives
+        both paths with this exact contract."""
+        cfg = config or self.generation
+        ids = np.asarray(input_ids)
+        B, S = ids.shape
+        lens = (np.full((B,), S, np.int64) if prompt_lens is None
+                else np.asarray(prompt_lens))
+        rids = [self.submit(ids[b, :int(lens[b])],
+                            max_new_tokens=cfg.max_new_tokens,
+                            eos_token_id=cfg.eos_token_id)
+                for b in range(B)]
+        self.run()
+        out = np.full((B, cfg.max_new_tokens), cfg.pad_token_id, np.int32)
+        for b, rid in enumerate(rids):
+            toks = self.requests[rid].out_tokens
+            out[b, :len(toks)] = toks
+        return out
+
+    # -- telemetry ---------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "steps": self.steps_run,
+            "decode_steps": self.decode_steps,
+            "mixed_steps": self.mixed_steps,
+            "tokens_generated": self.tokens_generated,
+            "preemptions": self.scheduler.preemptions,
+            "admissions": self.scheduler.admissions,
+            "aborts": self.aborts,
+            "kv_pool_bytes": pool_bytes(self.pools),
+            "kv_blocks_peak": self.allocator.peak_used,
+            "kv_blocks_free": self.allocator.free_blocks,
+            "failed_allocs": self.allocator.failed_allocs,
+            "compiled_widths": sorted(self._steps),
+        }

@@ -1,0 +1,222 @@
+"""Block-paged KV cache: static pools, a host-side block allocator, and
+the pytree view the model's attention core consumes.
+
+The dense decode cache (``model.init_kv_cache``) reserves ``[B, S_max]``
+rows per request — at serving batch sizes that is almost entirely dead HBM
+(most requests are far shorter than the max).  The paged cache instead
+keeps ONE static pool of fixed-size blocks per layer,
+
+    ``k/v: [num_blocks, block_size, Hk, D]``  (position-major),
+
+and a per-request *block table* mapping position ``p`` to slot ``p %
+block_size`` of block ``table[p // block_size]``.  Blocks are recycled
+through a free list as requests finish, so the pool sizes to the TOTAL
+live tokens, not ``max_num_seqs * max_model_len``.  Everything the jitted
+step touches is static-shape: pools, ``[B, MB]`` block tables, ``[B, S]``
+slot mappings — allocation is pure host bookkeeping
+(:class:`BlockAllocator`), never a trace event.
+
+Block 0 is the reserved **null page**: pad tokens write into it and pad
+block-table entries point at it, so scatter/gather shapes stay static and
+garbage is never read (context-length masks exclude it).
+
+``serving.kv_cache_dtype: int8`` stores the pools quantized with per-slot
+per-kv-head scale planes ``[num_blocks, block_size, Hk]`` — the scale
+rides the same block layout as the data, so one block table addresses
+both.  Quantize/rescale reuses PR-10's machinery (``ops/quant.quant_cast``
+at write, broadcast rescale at read — in-VMEM inside the Pallas decode
+rung, XLA-fused in the gather fallback).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# ``serving.kv_cache_dtype`` config domain (enum-validated at config load
+# like cp_layout / moe.dispatch — see loader._enum_fields).  ``auto``
+# stores the model's compute dtype.
+KV_CACHE_DTYPES = ("auto", "int8")
+DEFAULT_KV_CACHE_DTYPE = "auto"
+
+
+def normalize_kv_cache_dtype(v):
+    from automodel_tpu.config.loader import normalize_null_spelling
+
+    return normalize_null_spelling(v)
+
+
+def validate_kv_cache_dtype(v: Optional[str]) -> Optional[str]:
+    if v is None:
+        return None
+    if v not in KV_CACHE_DTYPES:
+        raise ValueError(
+            f"serving.kv_cache_dtype must be one of {list(KV_CACHE_DTYPES)} "
+            f"(or null for the default), got {v!r}")
+    return v
+
+
+class OutOfBlocks(RuntimeError):
+    """KV pool exhausted — the scheduler converts this into a preemption
+    (a request parked back to WAITING with its blocks freed), never a
+    crash."""
+
+
+class BlockAllocator:
+    """Host-side free-list allocator over the pool's block ids.
+
+    Block 0 is reserved as the null page (never handed out); allocation
+    and free are O(n) list ops on python ints — deterministic, no device
+    traffic.  ``peak_used`` / ``failed_allocs`` feed the engine's stats.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 KV blocks (1 null + 1 usable), got {num_blocks}")
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self.peak_used = 0
+        self.failed_allocs = 0
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        """``n`` block ids, or :class:`OutOfBlocks` (nothing handed out —
+        all-or-nothing, so a failed grab never leaks)."""
+        if n > len(self._free):
+            self.failed_allocs += 1
+            raise OutOfBlocks(
+                f"KV pool exhausted: requested {n} blocks, "
+                f"{len(self._free)} free of {self.num_blocks - 1}")
+        out = [self._free.pop() for _ in range(n)]
+        self.peak_used = max(self.peak_used, self.used_blocks)
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if not 1 <= b < self.num_blocks:
+                raise ValueError(f"freeing unknown block id {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+        self._free.extend(reversed(blocks))
+
+
+def init_paged_pools(*, num_layers: int, num_kv_heads: int, head_dim: int,
+                     num_blocks: int, block_size: int, cache_dtype,
+                     quantized: bool) -> Dict[str, jnp.ndarray]:
+    """The static per-layer-stacked pools: ``{"k"|"v": [L, NB, BS, Hk, D]}``
+    plus ``{"k_scale"|"v_scale": [L, NB, BS, Hk]}`` when quantized."""
+    shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
+    dtype = jnp.int8 if quantized else jnp.dtype(cache_dtype)
+    pools = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if quantized:
+        # two distinct buffers: the step donates the pools, and XLA
+        # rejects donating one buffer twice
+        pools["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        pools["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+    return pools
+
+
+def pool_bytes(pools: Dict[str, jnp.ndarray]) -> int:
+    return sum(int(x.size) * x.dtype.itemsize for x in pools.values())
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class PagedKVView:
+    """The paged cache as one model forward sees it — a pytree whose array
+    leaves are the pools and the per-step addressing arrays, with the
+    layout facts (block size, quantization) as static aux data.
+
+    ``forward_embeds`` splits the view: the ``[L, ...]`` pools ride the
+    layer scan's ``xs`` while the addressing arrays are closed over (they
+    are shared by every layer); :meth:`layer_view` rewraps the per-layer
+    pool slice inside the scan body.
+    """
+
+    pools: Dict[str, jnp.ndarray]
+    block_tables: jnp.ndarray     # [B, MB] int32
+    slot_mapping: jnp.ndarray     # [B, S] int32 flat slot per written token
+    context_lens: jnp.ndarray     # [B] int32, INCLUDING this step's writes
+    positions: jnp.ndarray        # [B, S] int32 absolute query positions
+    block_size: int = 16
+    quantized: bool = False
+
+    def tree_flatten(self):
+        children = (self.pools, self.block_tables, self.slot_mapping,
+                    self.context_lens, self.positions)
+        return children, (self.block_size, self.quantized)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, block_size=aux[0], quantized=aux[1])
+
+    def layer_view(self, layer_pools: Dict[str, jnp.ndarray]) -> "PagedKVView":
+        return PagedKVView(
+            layer_pools, self.block_tables, self.slot_mapping,
+            self.context_lens, self.positions,
+            block_size=self.block_size, quantized=self.quantized)
+
+    # -- the model-facing seam (llama._attention_core's paged branch) ------
+    def write(self, k: jnp.ndarray, v: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        """Scatter this step's ``[B, S, Hk, D]`` k/v into the (per-layer)
+        pools at ``slot_mapping`` (pad tokens land in null page 0) and
+        return the updated pools dict.  int8 pools quantize per written
+        slot per kv head (PR-10's ``quant_cast``), storing the scale in
+        the matching scale plane."""
+        B, S, Hk, D = k.shape
+        slots = self.slot_mapping.reshape(-1)
+        pools = dict(self.pools)
+        for name, x in (("k", k), ("v", v)):
+            pool = pools[name]
+            flat = x.reshape(B * S, Hk, D)
+            if self.quantized:
+                from automodel_tpu.ops.quant import INT8_MAX, quant_cast
+
+                amax = jnp.max(jnp.abs(flat.astype(jnp.float32)), axis=-1)
+                sc = jnp.maximum(amax, 1e-12) / INT8_MAX      # [B*S, Hk]
+                flat = quant_cast(flat, sc[..., None], jnp.int8)
+                spool = pools[name + "_scale"]
+                pools[name + "_scale"] = spool.reshape(-1, Hk).at[
+                    slots].set(sc).reshape(spool.shape)
+            else:
+                flat = flat.astype(pool.dtype)
+            pools[name] = pool.reshape(-1, Hk, D).at[slots].set(
+                flat).reshape(pool.shape)
+        return pools
+
+    def attend(self, q: jnp.ndarray, pools: Dict[str, jnp.ndarray], *,
+               scale=None, logits_soft_cap=None, local_window_size=None
+               ) -> jnp.ndarray:
+        """Paged attention of ``q [B, S, Hq, D]`` over the (freshly
+        written) pools, through the ``attention.paged_decode`` chain."""
+        from automodel_tpu.ops.paged_attention import paged_attention
+
+        return paged_attention(
+            q, pools["k"], pools["v"],
+            k_scale=pools.get("k_scale"), v_scale=pools.get("v_scale"),
+            block_tables=self.block_tables, context_lens=self.context_lens,
+            positions=self.positions, scale=scale,
+            logits_soft_cap=logits_soft_cap,
+            local_window_size=local_window_size)
+
+
+def slot_for(block_table: List[int], position: int, block_size: int) -> int:
+    """Host-side flat pool slot of ``position`` under a request's block
+    table (the addressing rule in one place)."""
+    return block_table[position // block_size] * block_size \
+        + position % block_size
+
+
+def blocks_needed(tokens: int, block_size: int) -> int:
+    return -(-tokens // block_size)

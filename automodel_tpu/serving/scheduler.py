@@ -1,0 +1,316 @@
+"""Continuous-batching scheduler: per-request state machines over static
+step slots.
+
+Pure host logic — no jax imports, no device traffic — so the state machine
+is unit-testable in microseconds and the jitted step only ever sees the
+static-shape buffers the engine assembles from a :class:`StepPlan`.
+
+The request lifecycle::
+
+    WAITING --admit--> PREFILL --prompt done--> DECODE --eos/max--> FINISHED
+       ^                  |                        |
+       +---- preempt -----+------------------------+      (abort -> ABORTED)
+
+One unifying invariant drives every transition: a request's *pending*
+tokens are ``(prompt + out_tokens)[num_computed:]`` — the tokens not yet
+written to the KV cache.  Prefill steps consume up to ``prefill_chunk`` of
+them, decode steps exactly one; whenever a step empties the pending list,
+the model's sampled token for that row is appended (mid-prompt samples are
+discarded).  Preemption (KV pool exhaustion, the ``serve_block_alloc``
+fault point) frees a victim's blocks and resets ``num_computed`` to 0 —
+the vLLM "recompute" policy: on re-admission the prompt AND the tokens
+generated so far re-prefill, which under greedy decoding reproduces the
+identical continuation, so a preempted request is slower, never wrong.
+
+Scheduling policies (``serving.scheduler_policy``):
+
+* ``fcfs`` — admission and preemption-victim order by arrival: oldest
+  admits first, youngest is preempted first (a preempted elder re-admits
+  ahead of the request that displaced it).
+* ``sjf``  — shortest pending work first (arrival breaks ties): better
+  p50 under mixed lengths, starvation-prone under sustained load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional, Sequence
+
+from automodel_tpu.serving.kv_cache import (
+    BlockAllocator,
+    OutOfBlocks,
+    blocks_needed,
+)
+from automodel_tpu.utils.fault_injection import InjectedFault, fault_point
+
+# ``serving.scheduler_policy`` config domain (enum-validated at config
+# load like cp_layout / moe.dispatch — see loader._enum_fields).
+SCHEDULER_POLICIES = ("fcfs", "sjf")
+DEFAULT_SCHEDULER_POLICY = "fcfs"
+
+
+def normalize_scheduler_policy(v):
+    from automodel_tpu.config.loader import normalize_null_spelling
+
+    return normalize_null_spelling(v)
+
+
+def validate_scheduler_policy(v: Optional[str]) -> Optional[str]:
+    if v is None:
+        return None
+    if v not in SCHEDULER_POLICIES:
+        raise ValueError(
+            f"serving.scheduler_policy must be one of "
+            f"{list(SCHEDULER_POLICIES)} (or null for the default), got "
+            f"{v!r}")
+    return v
+
+
+class RequestState(enum.Enum):
+    WAITING = "waiting"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    FINISHED = "finished"
+    ABORTED = "aborted"
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request and its cache bookkeeping."""
+
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    state: RequestState = RequestState.WAITING
+    out_tokens: List[int] = dataclasses.field(default_factory=list)
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    num_computed: int = 0          # tokens written to the KV cache
+    slot: Optional[int] = None     # step-buffer row while active
+    arrival: int = 0               # admission-order tiebreak
+    preemptions: int = 0
+
+    @property
+    def seq(self) -> List[int]:
+        return self.prompt + self.out_tokens
+
+    @property
+    def pending(self) -> List[int]:
+        return self.seq[self.num_computed:]
+
+    @property
+    def finished(self) -> bool:
+        return self.state in (RequestState.FINISHED, RequestState.ABORTED)
+
+
+@dataclasses.dataclass
+class RowWork:
+    """One step-buffer row's work: ``tokens`` written at positions
+    ``start_pos..start_pos+len(tokens)-1``; ``samples_next`` marks the row
+    whose sampled token extends the request (pending emptied)."""
+
+    req: Request
+    tokens: List[int]
+    start_pos: int
+    samples_next: bool
+
+
+@dataclasses.dataclass
+class StepPlan:
+    rows: List[Optional[RowWork]]      # len == max_num_seqs, None = idle
+    step_width: int                    # 1 (pure decode) or prefill_chunk
+
+    @property
+    def active(self) -> List[RowWork]:
+        return [r for r in self.rows if r is not None]
+
+
+class Scheduler:
+    """Admission + step assembly + preemption over ``max_num_seqs`` slots."""
+
+    def __init__(self, allocator: BlockAllocator, *, max_num_seqs: int,
+                 prefill_chunk: int, block_size: int, max_model_len: int,
+                 policy: str = DEFAULT_SCHEDULER_POLICY):
+        policy = validate_scheduler_policy(normalize_scheduler_policy(policy))
+        self.allocator = allocator
+        self.max_num_seqs = max_num_seqs
+        self.prefill_chunk = prefill_chunk
+        self.block_size = block_size
+        self.max_model_len = max_model_len
+        self.policy = policy or DEFAULT_SCHEDULER_POLICY
+        self.waiting: List[Request] = []
+        self.slots: List[Optional[Request]] = [None] * max_num_seqs
+        self._arrivals = 0
+        self.preemptions = 0
+        self.admissions = 0
+
+    # -- intake ------------------------------------------------------------
+    def add(self, req: Request) -> None:
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.max_model_len:
+            raise ValueError(
+                f"request {req.rid}: prompt {len(req.prompt)} + "
+                f"max_new_tokens {req.max_new_tokens} exceeds "
+                f"serving.max_model_len {self.max_model_len}")
+        if blocks_needed(total, self.block_size) \
+                > self.allocator.num_blocks - 1:
+            raise ValueError(
+                f"request {req.rid} needs "
+                f"{blocks_needed(total, self.block_size)} KV blocks but the "
+                f"pool has {self.allocator.num_blocks - 1} — raise "
+                "serving.num_kv_blocks / max_model_len")
+        req.arrival = self._arrivals
+        self._arrivals += 1
+        req.state = RequestState.WAITING
+        self.waiting.append(req)
+
+    def abort(self, req: Request) -> None:
+        """Cancel anywhere in the lifecycle: frees the block table, vacates
+        the slot — the ``serve_request_abort`` contract."""
+        if req.finished:
+            return
+        if req in self.waiting:
+            self.waiting.remove(req)
+        if req.slot is not None:
+            self.slots[req.slot] = None
+            req.slot = None
+        if req.blocks:
+            self.allocator.free(req.blocks)
+            req.blocks = []
+        req.state = RequestState.ABORTED
+
+    @property
+    def active(self) -> List[Request]:
+        return [r for r in self.slots if r is not None]
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.active)
+
+    # -- internals ---------------------------------------------------------
+    def _policy_key(self, req: Request):
+        if self.policy == "sjf":
+            return (len(req.pending) + req.max_new_tokens
+                    - len(req.out_tokens), req.arrival)
+        return req.arrival                                   # fcfs
+
+    def _allocate(self, n: int) -> List[int]:
+        # The drilled KV-exhaustion site: an armed ``serve_block_alloc``
+        # fires here exactly like a genuinely empty free list, and the
+        # caller's preemption path must absorb both identically.
+        fault_point("serve_block_alloc")
+        return self.allocator.allocate(n)
+
+    def _preempt(self, victim: Request) -> None:
+        assert victim.slot is not None
+        self.slots[victim.slot] = None
+        victim.slot = None
+        if victim.blocks:
+            self.allocator.free(victim.blocks)
+            victim.blocks = []
+        victim.num_computed = 0          # recompute policy (see docstring)
+        victim.state = RequestState.WAITING
+        victim.preemptions += 1
+        self.preemptions += 1
+        self.waiting.append(victim)
+
+    def _ensure_blocks(self, req: Request, new_total: int) -> bool:
+        """Grow ``req``'s block table to cover ``new_total`` positions,
+        preempting strictly-younger active requests (youngest first) while
+        the pool is exhausted; parks ``req`` itself when it is the
+        youngest.  Returns False when ``req`` was preempted."""
+        need = blocks_needed(new_total, self.block_size) - len(req.blocks)
+        while True:
+            try:
+                if need > 0:
+                    req.blocks.extend(self._allocate(need))
+                return True
+            except (OutOfBlocks, InjectedFault) as e:
+                younger = [r for r in self.active
+                           if r is not req and r.arrival > req.arrival]
+                if younger:
+                    self._preempt(max(younger, key=lambda r: r.arrival))
+                    continue
+                if (len(self.active) > 1 or req.blocks
+                        or isinstance(e, InjectedFault)):
+                    # an injected alloc failure is always absorbed as a
+                    # preemption (the drilled contract: never a crash);
+                    # genuine exhaustion only raises in the provably
+                    # impossible solo-request-no-blocks state below
+                    self._preempt(req)
+                    return False
+                raise OutOfBlocks(
+                    f"request {req.rid} alone cannot fit: needs {need} more "
+                    f"blocks, pool has {self.allocator.num_blocks - 1} "
+                    "total — raise serving.num_kv_blocks")
+
+    def _admit(self) -> None:
+        for req in sorted(self.waiting, key=self._policy_key):
+            free_slots = [i for i, r in enumerate(self.slots) if r is None]
+            if not free_slots:
+                return
+            first_chunk = min(len(req.pending), self.prefill_chunk)
+            if self.allocator.free_blocks * self.block_size < first_chunk:
+                continue         # in-flight admission waits for frees
+            self.waiting.remove(req)
+            req.slot = free_slots[0]
+            self.slots[req.slot] = req
+            req.state = RequestState.PREFILL
+            self.admissions += 1
+
+    # -- the per-step contract --------------------------------------------
+    def schedule(self) -> Optional[StepPlan]:
+        """Admit what fits, grow block tables (preempting under pressure),
+        and emit this step's :class:`StepPlan` — or None when idle."""
+        self._admit()
+        if not self.active:
+            return None
+        width = self.prefill_chunk if any(
+            len(r.pending) > 1 for r in self.active) else 1
+        rows: List[Optional[RowWork]] = [None] * self.max_num_seqs
+        for req in list(self.active):
+            if req.slot is None:
+                continue       # preempted by an earlier row's allocation
+            t = min(len(req.pending), width)
+            if not self._ensure_blocks(req, req.num_computed + t):
+                continue                       # preempted back to WAITING
+            rows[req.slot] = RowWork(
+                req=req, tokens=req.pending[:t], start_pos=req.num_computed,
+                samples_next=req.num_computed + t == len(req.seq))
+        for i, w in enumerate(rows):
+            if w is not None and w.req.slot != i:
+                # a LATER row's allocation preempted this already-planned
+                # victim (slot order can diverge from arrival order after a
+                # finish + re-admission): its blocks are freed and its
+                # num_computed reset, so the stale RowWork must not run
+                rows[i] = None
+        if not any(r is not None for r in rows):
+            return self.schedule() if self.has_work() else None
+        return StepPlan(rows=rows, step_width=width)
+
+    def finish_step(self, plan: StepPlan,
+                    sampled: Dict[int, int]) -> List[Request]:
+        """Apply one executed plan: advance ``num_computed``, append the
+        sampled token where the pending list emptied, retire finished
+        requests (freeing their blocks).  ``sampled`` maps slot -> token."""
+        done: List[Request] = []
+        for work in plan.active:
+            req = work.req
+            req.num_computed += len(work.tokens)
+            if not work.samples_next:
+                continue
+            tok = int(sampled[req.slot])
+            req.out_tokens.append(tok)
+            hit_eos = (req.eos_token_id is not None
+                       and tok == req.eos_token_id)
+            if hit_eos or len(req.out_tokens) >= req.max_new_tokens:
+                self.slots[req.slot] = None
+                req.slot = None
+                if req.blocks:
+                    self.allocator.free(req.blocks)
+                    req.blocks = []
+                req.state = RequestState.FINISHED
+                done.append(req)
+            else:
+                req.state = RequestState.DECODE
+        return done

@@ -104,6 +104,22 @@ Checkpoint-replication points (see ``checkpoint/replication.py``):
                       shard mid-fetch.  Contract: the restore silently
                       falls back to the storage path with a warning,
                       byte-identical state, ``restore_source=storage``.
+
+Serving-engine points (see ``serving/scheduler.py`` / ``serving/engine.py``):
+
+    serve_block_alloc in ``Scheduler._allocate``, at the top of every KV
+                      block grab — an armed fault behaves exactly like a
+                      genuinely exhausted pool.  Contract: the requesting
+                      row is PREEMPTED back to WAITING with its blocks
+                      freed (recompute policy — greedy output stays
+                      token-identical), never a crash; younger active
+                      requests are victimized first.
+    serve_request_abort
+                      in ``DecodeEngine.step``, before the plan is built —
+                      models a client cancelling mid-decode.  Contract:
+                      the oldest active request is aborted, its whole
+                      block table returns to the free list immediately,
+                      and every other request's output is unaffected.
 """
 
 from __future__ import annotations
@@ -138,6 +154,8 @@ KNOWN_FAULT_POINTS = frozenset({
     "elastic_readmit",
     "ckpt_replica_push",
     "ckpt_replica_restore",
+    "serve_block_alloc",
+    "serve_request_abort",
 })
 
 

@@ -170,6 +170,18 @@ SECONDARY = {
     # drill window) as extra secondary keys.  ``BENCH_ELASTIC=0`` skips
     # the leg (records null).
     "elastic": [],
+    # Serving legs (docs/guides/serving.md; BENCH_SERVE=0 skips both):
+    # ``decode_tok_s`` — _serve_decode_secondary_main: generated tokens/s
+    # through the paged decode engine at batch 64, with _vs_baseline =
+    # batch-64 tok/s / batch-1 tok/s (the continuous-batching win: decode
+    # is bandwidth-bound, so rows are nearly free until compute saturates).
+    "decode_tok_s": [],
+    # ``serve`` — _serve_trace_secondary_main: a seeded DETERMINISTIC
+    # Poisson arrival trace (drawn host-side up front — no randomness in
+    # jitted code) through the engine's continuous-batching loop; reports
+    # requests_s plus serve_p50_ms / serve_p99_ms end-to-end latency as
+    # extra secondary keys.
+    "serve": [],
     # Checkpoint-stall leg: handled by _ckpt_secondary_main — times a
     # training window containing saves under checkpoint.async_save true vs
     # false through the real recipe save path.  Reports the mean per-save
@@ -558,6 +570,127 @@ def _elastic_secondary_main() -> None:
     }))
 
 
+def _serve_engine(model, params, *, max_num_seqs, max_model_len,
+                  max_new_tokens):
+    from automodel_tpu.generation import GenerationConfig
+    from automodel_tpu.serving import DecodeEngine, ServingConfig
+
+    return DecodeEngine(
+        model, params,
+        ServingConfig(kv_block_size=16, max_num_seqs=max_num_seqs,
+                      max_model_len=max_model_len, prefill_chunk=32),
+        generation=GenerationConfig(max_new_tokens=max_new_tokens))
+
+
+def _serve_model():
+    import jax
+
+    model = _tiny_quant_llama()
+    params = model.init(jax.random.key(0))
+    return model, params
+
+
+def _serve_decode_secondary_main() -> None:
+    """Child process: decode tokens/s through the paged engine at batch 1
+    vs batch 64.
+
+    Every request decodes the same token budget, so the ratio isolates the
+    continuous-batching win: decode is bandwidth-bound and a step's cost
+    barely moves with rows until the chip saturates.  Absolute tok/s on a
+    CPU dev host is not chip-meaningful; the b64/b1 RATIO is the metric
+    (the leg's vs_baseline).  ``BENCH_SERVE=0`` skips.
+    """
+    if os.environ.get("BENCH_SERVE", "1") == "0":
+        raise SystemExit("BENCH_SERVE=0: serving legs skipped")
+    model, params = _serve_model()
+    n_req, max_new = (8, 8) if SMALL else (64, 32)
+    prompt_len = 24
+    rng = np.random.default_rng(0)
+    prompts = [[int(t) for t in rng.integers(1, 2000, prompt_len)]
+               for _ in range(n_req)]
+
+    def run(batch: int) -> float:
+        eng = _serve_engine(model, params, max_num_seqs=batch,
+                            max_model_len=prompt_len + max_new,
+                            max_new_tokens=max_new)
+        eng.submit(prompts[0])     # warm both step widths off the clock
+        eng.run()
+        t0 = time.perf_counter()
+        for p in prompts:
+            eng.submit(p)
+        eng.run()
+        dt = time.perf_counter() - t0
+        return n_req * max_new / dt
+
+    b1 = run(1)
+    bN = run(n_req)
+    print(json.dumps({"tps": round(bN, 1),
+                      "vs_baseline": round(bN / b1, 4)}))
+
+
+def _serve_trace_secondary_main() -> None:
+    """Child process: requests/s + p50/p99 latency under a seeded
+    deterministic Poisson arrival trace.
+
+    The whole trace (inter-arrival exponentials + prompt ids) is drawn
+    HOST-SIDE up front from one seeded generator — nothing random near the
+    jitted step (L003).  The engine loop steps continuously; a request is
+    submitted once the wall clock passes its arrival offset, and its
+    latency is completion minus (offset-adjusted) arrival.  Absolute ms on
+    a dev host is not chip-meaningful — the leg exists so the latency
+    distribution stays BOUNDED run over run and the continuous-batching
+    path is exercised under bursty arrivals.  ``BENCH_SERVE=0`` skips.
+    """
+    if os.environ.get("BENCH_SERVE", "1") == "0":
+        raise SystemExit("BENCH_SERVE=0: serving legs skipped")
+    model, params = _serve_model()
+    n_req, max_new, seqs = (6, 8, 4) if SMALL else (32, 24, 8)
+    rng = np.random.default_rng(0)
+    prompts = [[int(t) for t in rng.integers(1, 2000, int(n))]
+               for n in rng.integers(8, 33, n_req)]
+    eng = _serve_engine(model, params, max_num_seqs=seqs,
+                        max_model_len=32 + max_new,
+                        max_new_tokens=max_new)
+    eng.submit(prompts[0])         # warm both step widths off the clock
+    eng.run()
+
+    # mean inter-arrival sized so the trace genuinely overlaps requests on
+    # this host: a rough per-token cost probe scales the arrival rate
+    probe0 = time.perf_counter()
+    eng.submit(prompts[0])
+    eng.run()
+    per_req = time.perf_counter() - probe0
+    arrivals = np.cumsum(rng.exponential(per_req / 2, size=n_req))
+
+    lat = {}
+    t0 = time.perf_counter()
+    submitted = 0
+    rids = {}
+    while submitted < n_req or eng.scheduler.has_work():
+        now = time.perf_counter() - t0
+        while submitted < n_req and arrivals[submitted] <= now:
+            rids[eng.submit(prompts[submitted])] = submitted
+            submitted += 1
+        done = eng.step()
+        now = time.perf_counter() - t0
+        for req in done:
+            if req.rid in rids:
+                lat[req.rid] = now - arrivals[rids[req.rid]]
+        if not eng.scheduler.has_work() and submitted < n_req:
+            # the next arrival's offset may already be in the past when the
+            # engine drained mid-step — never hand sleep() a negative
+            time.sleep(max(0.0, min(0.001, arrivals[submitted] - now)))
+    wall = time.perf_counter() - t0
+    ms = np.asarray(sorted(lat.values())) * 1e3
+    print(json.dumps({
+        "tps": round(n_req / wall, 2),
+        "requests_s": round(n_req / wall, 2),
+        "serve_p50_ms": round(float(np.percentile(ms, 50)), 2),
+        "serve_p99_ms": round(float(np.percentile(ms, 99)), 2),
+        "serve_preemptions": eng.scheduler.preemptions,
+    }))
+
+
 def _ckpt_secondary_main() -> None:
     """Child process: the checkpoint-stall leg.
 
@@ -651,6 +784,10 @@ def _secondary_main(name: str) -> None:
         return _ckpt_secondary_main()
     if name == "elastic":
         return _elastic_secondary_main()
+    if name == "decode_tok_s":
+        return _serve_decode_secondary_main()
+    if name == "serve":
+        return _serve_trace_secondary_main()
     steps, warmup = (4, 2) if SMALL else (8, 3)
     if name == "unpacked" and not SMALL:
         # two length buckets (1024/1152) after the 128-alignment: warm both

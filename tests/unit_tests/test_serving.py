@@ -1,0 +1,594 @@
+"""Serving engine: paged-KV decode parity vs ``generate()``, continuous
+batching invariants, fault drills, config validation, and the paged
+attention kernels' parity-harness cases.
+
+The anchor is the PARITY ORACLE: greedy decode through the engine (paged
+cache, chunked prefill, continuous batching) must be token-identical to
+``generation.generate`` (dense cache, lockstep batch) on the same model
+and params — batch-of-one, mixed-length batches, under preemption
+pressure, and across scheduler policies.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.analysis.jaxpr_audit import (
+    assert_compiles_once,
+    jaxpr_census,
+)
+from automodel_tpu.generation import GenerationConfig, generate
+from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from automodel_tpu.serving import (
+    BlockAllocator,
+    DecodeEngine,
+    OutOfBlocks,
+    Request,
+    RequestState,
+    Scheduler,
+    ServingConfig,
+    build_serving_config,
+)
+from automodel_tpu.serving.kv_cache import blocks_needed
+from automodel_tpu.utils import fault_injection as fi
+
+CFG = LlamaConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128,
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    rope_theta=10000.0, tie_word_embeddings=True,
+    max_position_embeddings=128)
+
+LENS = [9, 6, 13, 5]
+MAX_NEW = 8
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = LlamaForCausalLM(CFG, param_dtype=jnp.float32,
+                             compute_dtype=jnp.float32, remat=False)
+    params = model.init(jax.random.key(0))
+    # perturb so argmax isn't degenerate
+    leaves, td = jax.tree.flatten(params)
+    keys = jax.random.split(jax.random.key(5), len(leaves))
+    params = jax.tree.unflatten(td, [
+        l + 0.05 * jax.random.normal(k, l.shape, l.dtype)
+        for l, k in zip(leaves, keys)])
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(1)
+    S = max(LENS)
+    ids = np.zeros((len(LENS), S), np.int64)
+    for b, n in enumerate(LENS):
+        ids[b, :n] = rng.integers(1, 255, n)
+    return ids
+
+
+@pytest.fixture(scope="module")
+def dense_oracle(model_and_params, prompts):
+    model, params = model_and_params
+    return np.asarray(generate(
+        model, params, prompts, prompt_lens=np.asarray(LENS),
+        config=GenerationConfig(max_new_tokens=MAX_NEW)))
+
+
+def _cfg(**kw):
+    base = dict(kv_block_size=8, max_num_seqs=4, max_model_len=64,
+                prefill_chunk=8)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def _engine(model_and_params, **kw):
+    model, params = model_and_params
+    return DecodeEngine(model, params, _cfg(**kw),
+                        generation=GenerationConfig(max_new_tokens=MAX_NEW))
+
+
+# ---------------------------------------------------------------------------
+# The parity oracle
+# ---------------------------------------------------------------------------
+def test_engine_greedy_token_identical_batch_of_one(model_and_params,
+                                                    prompts, dense_oracle):
+    for b, n in enumerate(LENS):
+        eng = _engine(model_and_params, max_num_seqs=1)
+        out = eng.generate(prompts[b:b + 1, :n])
+        np.testing.assert_array_equal(out[0], dense_oracle[b])
+
+
+def test_engine_greedy_token_identical_mixed_length_batch(
+        model_and_params, prompts, dense_oracle):
+    eng = _engine(model_and_params)
+    out = eng.generate(prompts, np.asarray(LENS))
+    np.testing.assert_array_equal(out, dense_oracle)
+    s = eng.stats()
+    assert s["mixed_steps"] >= 1 and s["decode_steps"] >= 1
+
+
+def test_engine_matches_generate_eos_semantics(model_and_params):
+    """eos is emitted, then pads — same contract as generate()."""
+    model, params = model_and_params
+    ids = np.asarray([[5, 6, 7, 8]], np.int64)
+    first = int(generate(model, params, ids,
+                         config=GenerationConfig(max_new_tokens=1))[0, 0])
+    cfg = GenerationConfig(max_new_tokens=6, eos_token_id=first,
+                           pad_token_id=0)
+    dense = generate(model, params, ids, config=cfg)
+    eng = DecodeEngine(model, params, _cfg(max_num_seqs=1), generation=cfg)
+    np.testing.assert_array_equal(eng.generate(ids, config=cfg), dense)
+    assert dense[0, 0] == first and (dense[0, 1:] == 0).all()
+
+
+def test_engine_preemption_recompute_is_token_identical(
+        model_and_params, prompts, dense_oracle):
+    """A pool too small for full residency forces preemptions; recompute
+    re-prefills prompt + generated-so-far, so greedy output is unchanged."""
+    eng = _engine(model_and_params, max_model_len=32, num_kv_blocks=9)
+    out = eng.generate(prompts, np.asarray(LENS))
+    np.testing.assert_array_equal(out, dense_oracle)
+    assert eng.scheduler.preemptions > 0
+    assert eng.allocator.failed_allocs > 0
+
+
+def test_engine_sjf_policy_same_tokens(model_and_params, prompts,
+                                       dense_oracle):
+    eng = _engine(model_and_params, max_num_seqs=2,
+                  scheduler_policy="sjf")
+    out = eng.generate(prompts, np.asarray(LENS))
+    np.testing.assert_array_equal(out, dense_oracle)
+
+
+def test_engine_sliding_window_model_token_identical(prompts):
+    """A Mistral-style global sliding window routes through the paged
+    rungs' window mask — same tokens as the dense cached path."""
+    cfg = dataclasses.replace(CFG, sliding_window=8, max_window_layers=0)
+    model = LlamaForCausalLM(cfg, param_dtype=jnp.float32,
+                             compute_dtype=jnp.float32, remat=False)
+    params = model.init(jax.random.key(2))
+    gen = GenerationConfig(max_new_tokens=MAX_NEW)
+    dense = generate(model, params, prompts, prompt_lens=np.asarray(LENS),
+                     config=gen)
+    eng = DecodeEngine(model, params, _cfg(), generation=gen)
+    np.testing.assert_array_equal(
+        eng.generate(prompts, np.asarray(LENS)), dense)
+
+
+def test_engine_sampling_deterministic(model_and_params, prompts):
+    """do_sample routes through host-side sample_logits with a per-step
+    folded key: same submissions -> same tokens, different engine seeds
+    may differ (shape/type contract either way)."""
+    gen = GenerationConfig(max_new_tokens=4, do_sample=True,
+                           temperature=0.8, top_k=20)
+    model, params = model_and_params
+
+    def run():
+        eng = DecodeEngine(model, params, _cfg(), generation=gen)
+        return eng.generate(prompts, np.asarray(LENS), gen)
+
+    a, b = run(), run()
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (len(LENS), 4) and a.dtype == np.int32
+
+
+# ---------------------------------------------------------------------------
+# Compile-once + decode-step census
+# ---------------------------------------------------------------------------
+def test_engine_compiles_once_per_width_across_churn(model_and_params):
+    """Admissions, finishes, in-flight arrivals and varying batch fills
+    must never retrace: exactly ONE compiled entry per step width."""
+    rng = np.random.default_rng(3)
+    eng = _engine(model_and_params)
+    lens = [9, 6, 13, 5, 11, 7]
+    ps = [[int(t) for t in rng.integers(1, 255, n)] for n in lens]
+    for p in ps[:3]:
+        eng.submit(p)
+    for _ in range(4):
+        eng.step()
+    for p in ps[3:]:              # in-flight admission mid-run
+        eng.submit(p)
+    eng.run()
+    assert sorted(eng._steps) == [1, 8]       # decode + prefill buckets
+    for width, fn in eng._steps.items():
+        assert_compiles_once(fn, f"serving step width={width}")
+
+
+def test_decode_step_census_clean(model_and_params):
+    """The single-chip decode step lowers with no collectives and no host
+    callbacks — nothing in the hot serving loop can sync or communicate."""
+    eng = _engine(model_and_params, max_num_seqs=2)
+    eng.submit([5, 6, 7])
+    while not eng._steps.get(1):
+        eng.step()
+    plan_args = None
+    # re-trace abstractly off the live jitted fn's signature
+    fn = eng._steps[1]
+    jaxpr = jax.make_jaxpr(
+        lambda *a: fn(*a))(eng.params, eng.pools,
+                           np.zeros((2, 1), np.int32),
+                           np.zeros((2, 1), np.int32),
+                           np.zeros((2, 1), np.int32),
+                           np.zeros((2, eng.max_blocks_per_seq), np.int32),
+                           np.ones((2,), np.int32),
+                           np.zeros((2,), np.int32))
+    census = jaxpr_census(jaxpr)
+    assert not census.collectives, census.collectives
+    assert not census.host_callbacks
+    del plan_args
+
+
+# ---------------------------------------------------------------------------
+# Fault drills (L005)
+# ---------------------------------------------------------------------------
+@pytest.mark.fault
+def test_fault_serve_block_alloc_preempts_never_crashes(
+        model_and_params, prompts, dense_oracle):
+    """An injected KV-pool exhaustion at the allocation site: the victim
+    request parks back to WAITING with its blocks freed, the run completes,
+    and greedy output is still token-identical."""
+    fi.configure_faults("serve_block_alloc:2")
+    try:
+        eng = _engine(model_and_params)
+        out = eng.generate(prompts, np.asarray(LENS))
+    finally:
+        fi.reset_faults()
+    np.testing.assert_array_equal(out, dense_oracle)
+    assert eng.scheduler.preemptions >= 1
+    # every block returned: nothing leaked through the preemption path
+    assert eng.allocator.used_blocks == 0
+    for r in eng.requests.values():
+        assert r.state is RequestState.FINISHED
+
+
+@pytest.mark.fault
+def test_fault_serve_request_abort_frees_block_table(
+        model_and_params, prompts, dense_oracle):
+    """A mid-decode cancel (armed ``serve_request_abort``): the aborted
+    request's whole block table returns to the free list immediately and
+    every other request's output is unaffected."""
+    fi.configure_faults("serve_request_abort:3")
+    try:
+        eng = _engine(model_and_params)
+        rids = [eng.submit(prompts[b, :LENS[b]]) for b in range(len(LENS))]
+        eng.run()
+    finally:
+        fi.reset_faults()
+    aborted = [r for r in eng.requests.values()
+               if r.state is RequestState.ABORTED]
+    assert len(aborted) == 1 and eng.aborts == 1
+    assert aborted[0].blocks == [] and aborted[0].slot is None
+    assert eng.allocator.used_blocks == 0
+    for r in eng.requests.values():
+        if r.state is RequestState.ABORTED:
+            continue
+        assert r.state is RequestState.FINISHED
+        b = rids.index(r.rid)
+        got = np.asarray(r.out_tokens
+                         + [0] * (MAX_NEW - len(r.out_tokens)), np.int32)
+        np.testing.assert_array_equal(got, dense_oracle[b])
+
+
+def test_abort_api_waiting_and_active(model_and_params):
+    eng = _engine(model_and_params, max_num_seqs=1)
+    r0 = eng.submit([5, 6, 7])
+    r1 = eng.submit([8, 9])          # queued behind r0 (one slot)
+    eng.step()
+    eng.abort(r1)                    # waiting abort
+    eng.abort(r0)                    # active abort frees its table
+    assert eng.requests[r0].state is RequestState.ABORTED
+    assert eng.requests[r1].state is RequestState.ABORTED
+    assert eng.allocator.used_blocks == 0
+    assert not eng.scheduler.has_work()
+
+
+# ---------------------------------------------------------------------------
+# int8 quantized KV cache: bounded + pinned
+# ---------------------------------------------------------------------------
+def test_int8_kv_decode_parity_bounded(model_and_params, prompts,
+                                       dense_oracle):
+    """The int8 cache quantizes per slot per kv head, so greedy decode
+    stays near-identical: first-step logits within 0.05 of the fp32 cache
+    (measured 0.0093 on this model) and >= 90% token match over the full
+    generation (measured 1.0)."""
+    model, params = model_and_params
+    eng = _engine(model_and_params, kv_cache_dtype="int8")
+    out = eng.generate(prompts, np.asarray(LENS))
+    match = float(np.mean(out == dense_oracle))
+    assert match >= 0.9, f"int8 KV token match {match}"
+
+    def first_step_logits(dtype):
+        e = DecodeEngine(
+            model, params,
+            _cfg(max_num_seqs=1, prefill_chunk=16, kv_cache_dtype=dtype),
+            generation=GenerationConfig(max_new_tokens=1))
+        e.submit(prompts[0, :LENS[0]], max_new_tokens=1)
+        plan = e.scheduler.schedule()
+        args = e._assemble(plan)
+        _, last, _ = e.step_fn(plan.step_width)(e.params, e.pools, *args)
+        return np.asarray(last)
+
+    dev = np.max(np.abs(first_step_logits(None)
+                        - first_step_logits("int8")))
+    assert dev < 0.05, f"int8 KV first-step logits deviated by {dev}"
+
+
+def test_int8_pool_is_actually_smaller(model_and_params):
+    full = _engine(model_and_params)
+    q = _engine(model_and_params, kv_cache_dtype="int8")
+    # int8 data (1/4 the fp32 bytes) + f32 scale planes (1/64 per element)
+    assert q.stats()["kv_pool_bytes"] < 0.5 * full.stats()["kv_pool_bytes"]
+    assert q.quantized and not full.quantized
+
+
+# ---------------------------------------------------------------------------
+# Allocator + scheduler units
+# ---------------------------------------------------------------------------
+def test_block_allocator_freelist_roundtrip():
+    a = BlockAllocator(6)            # 5 usable, block 0 reserved
+    got = a.allocate(3)
+    assert len(got) == 3 and 0 not in got
+    assert a.free_blocks == 2 and a.used_blocks == 3
+    with pytest.raises(OutOfBlocks):
+        a.allocate(3)
+    assert a.failed_allocs == 1
+    a.free(got)
+    assert a.free_blocks == 5 and a.peak_used == 3
+    with pytest.raises(ValueError):
+        a.free([got[0]])             # double free
+    with pytest.raises(ValueError):
+        a.free([0])                  # the null page is never allocable
+
+
+def test_scheduler_chunked_prefill_shares_step_with_decode():
+    a = BlockAllocator(64)
+    s = Scheduler(a, max_num_seqs=2, prefill_chunk=4, block_size=4,
+                  max_model_len=64)
+    long = Request(rid=0, prompt=list(range(1, 11)), max_new_tokens=4)
+    short = Request(rid=1, prompt=[1, 2], max_new_tokens=4)
+    s.add(short)
+    s.add(long)
+    p1 = s.schedule()
+    assert p1.step_width == 4                    # prefill step
+    by_rid = {w.req.rid: w for w in p1.active}
+    assert by_rid[1].tokens == [1, 2] and by_rid[1].samples_next
+    assert by_rid[0].tokens == list(range(1, 5)) and not by_rid[0].samples_next
+    s.finish_step(p1, {short.slot: 42})
+    assert short.state is RequestState.DECODE
+    assert long.state is RequestState.PREFILL
+    p2 = s.schedule()
+    assert p2.step_width == 4                    # long still prefilling
+    w_short = next(w for w in p2.active if w.req.rid == 1)
+    assert w_short.tokens == [42] and w_short.samples_next
+
+
+def test_scheduler_policy_orders_admission():
+    a = BlockAllocator(64)
+    s = Scheduler(a, max_num_seqs=1, prefill_chunk=8, block_size=4,
+                  max_model_len=64, policy="sjf")
+    big = Request(rid=0, prompt=list(range(1, 20)), max_new_tokens=4)
+    small = Request(rid=1, prompt=[1, 2], max_new_tokens=4)
+    s.add(big)
+    s.add(small)                     # arrives later but is shorter
+    plan = s.schedule()
+    assert plan.active[0].req.rid == 1           # sjf admits the short job
+    with pytest.raises(ValueError, match="scheduler_policy"):
+        Scheduler(a, max_num_seqs=1, prefill_chunk=8, block_size=4,
+                  max_model_len=64, policy="typo")
+
+
+def test_scheduler_rejects_oversized_request():
+    a = BlockAllocator(4)
+    s = Scheduler(a, max_num_seqs=1, prefill_chunk=8, block_size=4,
+                  max_model_len=8)
+    with pytest.raises(ValueError, match="max_model_len"):
+        s.add(Request(rid=0, prompt=list(range(8)), max_new_tokens=4))
+    s2 = Scheduler(a, max_num_seqs=1, prefill_chunk=8, block_size=4,
+                   max_model_len=64)
+    with pytest.raises(ValueError, match="KV blocks"):
+        s2.add(Request(rid=0, prompt=list(range(30)), max_new_tokens=4))
+
+
+def test_schedule_drops_victim_planned_before_its_preemption():
+    """Regression: slot order can diverge from arrival order (finish +
+    re-admission), so a LATER row's allocation can preempt a victim whose
+    RowWork was already placed in the plan.  The stale work must be
+    dropped — it would otherwise run with freed blocks (engine crash) and
+    corrupt the victim's recompute state via finish_step."""
+    a = BlockAllocator(6)            # 5 usable
+    s = Scheduler(a, max_num_seqs=2, prefill_chunk=8, block_size=4,
+                  max_model_len=20)
+    old = Request(rid=0, prompt=list(range(1, 19)), max_new_tokens=2)
+    young = Request(rid=2, prompt=[1, 2, 3], max_new_tokens=4)
+    s.add(old)
+    s.add(young)
+    # hand-wire the diverged state: the OLD request occupies slot 1
+    # mid-prefill (a short peer finished out of slot 0 earlier)
+    s.waiting.remove(old)
+    old.slot, s.slots[1] = 1, old
+    old.blocks = a.allocate(3)
+    old.num_computed = 12
+    old.state = RequestState.PREFILL
+    plan = s.schedule()
+    # slot 0 (young, planned first) grabbed 1 block; slot 1 (old) then
+    # needed 2 with 1 free -> preempted young AFTER it was planned
+    assert s.preemptions == 1
+    assert young.state is RequestState.WAITING
+    assert young.blocks == [] and young.num_computed == 0
+    assert [w.req.rid for w in plan.active] == [0]
+    for i, w in enumerate(plan.rows):
+        assert w is None or w.req.slot == i
+    # the dropped victim's sampled token must not be consumed either
+    done = s.finish_step(plan, {1: 42})
+    assert done == [] and old.num_computed == 18
+    assert old.out_tokens == [42] and young.out_tokens == []
+
+
+def test_blocks_needed():
+    assert blocks_needed(1, 16) == 1
+    assert blocks_needed(16, 16) == 1
+    assert blocks_needed(17, 16) == 2
+
+
+# ---------------------------------------------------------------------------
+# Config knobs: load-time enum validation + the example YAML
+# ---------------------------------------------------------------------------
+def test_serving_config_validation():
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        ServingConfig(kv_cache_dtype="int4")
+    with pytest.raises(ValueError, match="scheduler_policy"):
+        ServingConfig(scheduler_policy="lifo")
+    with pytest.raises(ValueError, match="kv_block_size"):
+        ServingConfig(kv_block_size=0)
+    with pytest.raises(ValueError, match="num_kv_blocks"):
+        ServingConfig(num_kv_blocks=1)
+    cfg = ServingConfig(kv_cache_dtype="none", scheduler_policy="null")
+    assert cfg.kv_cache_dtype is None and cfg.scheduler_policy is None
+    assert ServingConfig(max_model_len=100,
+                         kv_block_size=16).blocks_per_seq == 7
+
+
+def test_serving_enums_validated_at_config_load(tmp_path):
+    from automodel_tpu.config.loader import load_yaml_config
+
+    p = tmp_path / "bad.yaml"
+    p.write_text("serving:\n  kv_cache_dtype: int4\n")
+    with pytest.raises(ValueError, match="serving.kv_cache_dtype"):
+        load_yaml_config(str(p))
+    p.write_text("serving:\n  scheduler_policy: lifo\n")
+    with pytest.raises(ValueError, match="serving.scheduler_policy"):
+        load_yaml_config(str(p))
+
+
+def test_serving_enums_revalidated_after_cli_override():
+    from automodel_tpu.config.arg_parser import parse_args_and_load_config
+
+    yaml = "examples/serve/tiny_llama_serve.yaml"
+    cfg = parse_args_and_load_config(
+        ["--config", yaml, "--serving.scheduler_policy", "sjf"])
+    assert cfg.get("serving.scheduler_policy") == "sjf"
+    with pytest.raises(ValueError, match="serving.kv_cache_dtype"):
+        parse_args_and_load_config(
+            ["--config", yaml, "--serving.kv_cache_dtype", "int4"])
+
+
+def test_example_serve_yaml_end_to_end():
+    from automodel_tpu.config.loader import load_yaml_config
+
+    cfg = load_yaml_config("examples/serve/tiny_llama_serve.yaml")
+    scfg = build_serving_config(cfg)
+    assert scfg.kv_block_size == 16 and scfg.max_num_seqs == 8
+    model = cfg.model.instantiate()
+    model.param_dtype = model.compute_dtype = jnp.float32
+    params = model.init(jax.random.key(0))
+    eng = DecodeEngine(model, params, scfg,
+                       generation=GenerationConfig(max_new_tokens=4))
+    eng.submit([3, 4, 5])
+    out = eng.run()
+    assert len(out[0]) >= 1
+    with pytest.raises(ValueError, match="unknown serving config key"):
+        build_serving_config({"kv_blok_size": 8})
+
+
+# ---------------------------------------------------------------------------
+# The hellaswag-style online-eval consumer
+# ---------------------------------------------------------------------------
+def test_eval_engine_scores_identical_to_generate(model_and_params):
+    from automodel_tpu.datasets.llm.mock import build_unpacked_dataset
+    from automodel_tpu.serving.eval import (
+        greedy_continuation_score,
+        rows_from_dataset,
+        split_prompt_target,
+    )
+
+    model, params = model_and_params
+    ds = build_unpacked_dataset(num_sentences=8, vocab_size=200,
+                                mean_len=20, seed=3)
+    rows = rows_from_dataset(ds, limit=8)
+    assert rows
+    a = greedy_continuation_score(model, params, rows, via="generate")
+    b = greedy_continuation_score(model, params, rows, via="engine")
+    assert a["score"] == b["score"]
+    assert a["exact_match"] == b["exact_match"]
+    np.testing.assert_array_equal(a["tokens"], b["tokens"])
+
+    # SFT-masked rows (the hellaswag schema) split at the label boundary:
+    # labels are pre-shifted, so target starts one past the first real one
+    row = {"input_ids": [7, 8, 9, 10],
+           "labels": [-100, -100, 10, -100]}
+    assert split_prompt_target(row) == ([7, 8, 9], [10])
+
+
+def test_eval_config_dataset_via_engine(model_and_params):
+    from automodel_tpu.config.loader import load_yaml_config
+    from automodel_tpu.serving.eval import eval_config_dataset
+
+    model, params = model_and_params
+    cfg = load_yaml_config("examples/serve/tiny_llama_serve.yaml")
+    r_gen = eval_config_dataset(cfg, model, params, via="generate", limit=4)
+    r_eng = eval_config_dataset(cfg, model, params, via="engine", limit=4)
+    assert r_gen["score"] == r_eng["score"]
+    assert r_eng["rows"] == 4 and r_eng["via"] == "engine"
+
+
+# ---------------------------------------------------------------------------
+# Paged attention kernels on the shared parity harness
+# ---------------------------------------------------------------------------
+from automodel_tpu.ops.kernel_lib import parity  # noqa: E402
+
+_PAGED_CASES = parity.paged_attention_cases()
+
+
+@pytest.mark.parametrize("case", _PAGED_CASES,
+                         ids=[c["name"] for c in _PAGED_CASES])
+def test_paged_gather_parity(case):
+    parity.run_paged_attention_parity("attention.paged_gather", case)
+
+
+@pytest.mark.parametrize("case", _PAGED_CASES,
+                         ids=[c["name"] for c in _PAGED_CASES])
+def test_paged_decode_kernel_parity(case):
+    parity.run_paged_attention_parity("attention.paged_decode", case)
+
+
+def test_paged_chain_and_cpu_fallback(model_and_params):
+    """Chain shape + the CPU probe contract: off-TPU, the engine's traffic
+    resolves to the gather anchor; in interpret mode the Pallas rung
+    accepts single-token decode requests."""
+    from automodel_tpu.ops import paged_attention_kernel as pak
+    from automodel_tpu.ops.kernel_lib import registry
+
+    assert registry.fallback_chain("attention.paged_decode") == [
+        "attention.paged_decode", "attention.paged_gather"]
+    req = {"q_seq": 1, "head_dim": 128, "quantized": False}
+    assert registry.resolve("attention.paged_decode", req).name \
+        == "attention.paged_gather"
+    old = pak._INTERPRET
+    pak._INTERPRET = True
+    try:
+        assert registry.resolve("attention.paged_decode", req).name \
+            == "attention.paged_decode"
+        # chunked prefill never takes the decode rung
+        assert registry.resolve(
+            "attention.paged_decode",
+            {"q_seq": 8, "head_dim": 128, "quantized": False},
+        ).name == "attention.paged_gather"
+    finally:
+        pak._INTERPRET = old
+
+
+def test_paged_decode_sweep_adapter_registered():
+    from automodel_tpu.ops.kernel_lib.autotune import sweep_adapters
+
+    adapters = sweep_adapters()
+    assert "paged_decode" in adapters
+    req = {"num_q_heads": 4, "num_kv_heads": 2, "head_dim": 128,
+           "block_size": 16, "pages_per_seq": 4, "dtype": "float32",
+           "quantized": False}
+    cands = adapters["paged_decode"].candidates(req)
+    assert (2,) in cands and (1,) in cands
+    fields = adapters["paged_decode"].key_fields(req)
+    assert fields["hk"] == 2 and fields["g"] == 2
