@@ -30,6 +30,7 @@ LEG_NAMES: Tuple[str, ...] = (
     "dp2xcp2xtp2_zigzag",
     "moe_ep",
     "dcn2_dp2xtp2",
+    "pp2xdp2",
 )
 
 # Audit threshold for the tiny legs: every weight matrix of the tiny
@@ -107,7 +108,29 @@ def build_leg(name: str, dp: int = 2, cp: int = 2, tp: int = 2) -> Leg:
     if name not in LEG_NAMES:
         raise ValueError(f"unknown census leg {name!r}; known: {LEG_NAMES}")
 
-    if name == "dcn2_dp2xtp2":
+    if name == "pp2xdp2":
+        # Pipeline leg: pp=2 stages x dp=2 over the first 4 devices, the
+        # 1f1b schedule with k=2 microbatches on the tiny flagship.  The
+        # golden census is the PR-13 structural pin: stage-boundary
+        # ppermutes keyed to pp ONLY at the jaxpr level, HLO
+        # collective-permutes over pp, and nothing bigger than one boundary
+        # activation buffer ever all-gathered over pp (stage slabs stay
+        # home — see test_analysis.py::test_pp_leg_*).  Plain masked CE:
+        # the fused-linear-CE loss is hidden-state-based and the pipelined
+        # last stage computes logits (ensure_pp_compatible rejects it).
+        from automodel_tpu.loss.masked_ce import MaskedCrossEntropy
+        from automodel_tpu.training.pipeline import PipelineConfig
+
+        mm = MeshManager(pp_size=2, dp_size=2,
+                         devices=jax.devices()[:4])
+        model = flagship_tiny_model()
+        plan = build_parallel_plan(model, mm)
+        fns = build_train_step(
+            model, build_optimizer(name="adamw", lr=1e-3, weight_decay=0.01),
+            loss_fn=MaskedCrossEntropy(), plan=plan,
+            pipeline=PipelineConfig(pp_size=2, schedule="1f1b",
+                                    num_microbatches=2))
+    elif name == "dcn2_dp2xtp2":
         # Hierarchical DP over 2 emulated slices: dcn_dp=2 x dp_shard=2 x
         # tp=2 (the elastic dryrun topology).  Params replicate across
         # dcn_dp; the census must show the per-step grad all-reduce as the

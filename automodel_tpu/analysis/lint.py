@@ -35,6 +35,13 @@ it drifts silently):
   (``ops/kernel_lib/tiling.py``) so block-size choices stay on the
   autotuner and the VMEM-limit defaults stay uniform — a kernel that
   drifts off the substrate silently loses both.
+* **L007** — ``jax.lax.ppermute`` constructed outside ``ops/`` and
+  ``training/train_step.py``: the golden collective censuses pin every
+  permute's axis AND count, which is only a meaningful invariant while
+  the census can name the home of each one (the ring's cp rotation in
+  ``ops/ring_attention.py``, the pipeline's pp stage boundary in
+  ``training/train_step.py``).  A permute constructed elsewhere would
+  show up in a census diff with no owner to audit.
 
 Suppression syntax (same line as the finding)::
 
@@ -62,6 +69,8 @@ RULES: Dict[str, str] = {
             "fault-marked test",
     "L006": "raw Pallas BlockSpec/grid-spec/compiler-params construction "
             "outside ops/kernel_lib/",
+    "L007": "jax.lax.ppermute constructed outside ops/ and "
+            "training/train_step.py",
 }
 
 # L001: the moved-API table.  Keys are dotted attribute chains / import
@@ -97,7 +106,7 @@ _MOVED_IMPORT_FROMS: Dict[Tuple[str, str], str] = {
 # config domain (the convention CP_LAYOUTS / MOE_DISPATCHES established).
 _ENUM_CONST_RE = re.compile(
     r"^_?[A-Z][A-Z0-9_]*(LAYOUTS|DISPATCHES|MODES|SCHEMES|STRATEGIES|"
-    r"POLICIES|BACKENDS|FORMATS|KINDS|CHOICES|DTYPES|RECIPES)$")
+    r"POLICIES|BACKENDS|FORMATS|KINDS|CHOICES|DTYPES|RECIPES|SCHEDULES)$")
 
 # L003: banned call chains inside jit scope.
 _WALLCLOCK_CALLS = {
@@ -118,6 +127,12 @@ _METRIC_NAMES_RE = re.compile(r"^(m|dm|dmv|metrics|device_metrics)$")
 # L006: Pallas grid/block construction belongs to the kernel substrate.
 _L006_GRID_NAMES = {"BlockSpec", "GridSpec", "PrefetchScalarGridSpec"}
 _L006_EXEMPT_PREFIX = "automodel_tpu/ops/kernel_lib/"
+
+# L007: every ppermute's home must be known to the census.  Allowed: any
+# kernel/op under ops/ (the ring's cp rotation and friends) and the
+# pipelined step's stage-boundary shift in training/train_step.py.
+_L007_ALLOWED_PREFIX = "automodel_tpu/ops/"
+_L007_ALLOWED_FILES = {"automodel_tpu/training/train_step.py"}
 
 _SUPPRESS_RE = re.compile(
     r"#\s*lint:\s*disable=([A-Z0-9,\s]+?)\s*\(([^)]+)\)")
@@ -314,6 +329,9 @@ class _FileLinter(ast.NodeVisitor):
             "utils/jax_compat.py")
         posix = rel_path.replace(os.sep, "/")
         self.is_kernel_lib = _L006_EXEMPT_PREFIX in posix
+        self.is_ppermute_home = (_L007_ALLOWED_PREFIX in posix
+                                 or any(posix.endswith(f)
+                                        for f in _L007_ALLOWED_FILES))
         self.hot_file = any(d in posix for d in _HOT_DIRS)
         self.recipes_file = _RECIPES_DIR in posix
         self._jit_names = _jit_called_names(tree)
@@ -360,6 +378,16 @@ class _FileLinter(ast.NodeVisitor):
                         "Pallas block/grid specs through ops/kernel_lib/"
                         "tiling.py (the substrate's single construction "
                         "path)")
+        if (not self.is_ppermute_home and node.module
+                and node.module in ("jax.lax", "jax._src.lax.parallel")):
+            for alias in node.names:
+                if alias.name == "ppermute":
+                    self._emit(
+                        "L007", node,
+                        f"'from {node.module} import ppermute': collective "
+                        "permutes live in ops/ or training/train_step.py "
+                        "so the golden censuses can name every permute's "
+                        "home")
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
@@ -429,6 +457,14 @@ class _FileLinter(ast.NodeVisitor):
                     "call kernel_lib.tiling.compiler_params (which applies "
                     "the substrate's VMEM-limit default) instead of the "
                     "raw jax_compat shim")
+        if (not self.is_ppermute_home and chain
+                and chain.split(".")[-1] == "ppermute"):
+            self._emit(
+                "L007", node,
+                f"{chain!r} constructed outside ops/ and "
+                "training/train_step.py: the golden censuses pin permute "
+                "axes/counts and can only audit permutes whose home they "
+                "know — move it, or suppress with a justification")
         if chain and chain.split(".")[-1] == "fault_point" and node.args:
             arg = node.args[0]
             if isinstance(arg, ast.Constant) and isinstance(arg.value, str):

@@ -365,6 +365,7 @@ def _enum_fields():
     from automodel_tpu.ops.zigzag import CP_LAYOUTS
     from automodel_tpu.serving.kv_cache import KV_CACHE_DTYPES
     from automodel_tpu.serving.scheduler import SCHEDULER_POLICIES
+    from automodel_tpu.training.pipeline import PP_SCHEDULES
 
     return {
         "distributed.cp_layout": CP_LAYOUTS,
@@ -374,6 +375,7 @@ def _enum_fields():
         "fp8.recipe_name": QUANT_RECIPES,
         "serving.kv_cache_dtype": KV_CACHE_DTYPES,
         "serving.scheduler_policy": SCHEDULER_POLICIES,
+        "pipeline.schedule": PP_SCHEDULES,
     }
 
 
@@ -393,6 +395,13 @@ def _enum_normalizers():
 # before any recipe state is built from it.  YAML true/false and the CLI's
 # ``translate_value`` both produce real bools; anything else is a typo.
 _BOOL_FIELDS = ("checkpoint.async_save", "checkpoint.replicate_to_peers")
+
+# Positive-int-valued config fields validated the same way.  Null spellings
+# ("none"/"null"/"") mean "use the default" (``pipeline.num_microbatches:
+# null`` resolves to pp_size); anything else must be an integer >= 1 — a
+# typo'd microbatch count must fail at load, not as a reshape error deep in
+# the pipelined step's trace.
+_POSITIVE_INT_FIELDS = ("pipeline.pp_size", "pipeline.num_microbatches")
 
 
 def normalize_null_spelling(v: Any) -> Any:
@@ -432,6 +441,17 @@ def validate_config_enums(cfg: "ConfigNode") -> None:
             raise ValueError(
                 f"config field {dotted!r} must be a bool (or null for the "
                 f"default), got {v!r}")
+    for dotted in _POSITIVE_INT_FIELDS:
+        v = cfg.get(dotted, _UNSET)
+        if v is _UNSET:
+            continue
+        v = normalize_null_spelling(v)
+        if v is None:
+            continue
+        if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+            raise ValueError(
+                f"config field {dotted!r} must be an integer >= 1 (or null "
+                f"for the default), got {v!r}")
 
 
 def load_yaml_config(path: str) -> ConfigNode:

@@ -37,24 +37,29 @@ logger = logging.getLogger(__name__)
 
 # Canonical axis names, outermost (DCN) to innermost (ICI).
 #
-# ``pp`` is the RESERVED pipeline-parallel seam (size 1 today, absent in
-# both this framework and the reference — its README defers PP to a later
-# release).  The design when it lands, so 70B+ plans are not boxed out:
+# ``pp`` is the pipeline-parallel axis (the seam the seed reserved; real
+# since the 1F1B schedule landed — ``training/train_step.py`` +
+# ``training/pipeline.py``).  The design, exactly as the seam documented:
 #
-# * The layer stack is already a ``[L, ...]`` pytree scanned by one body —
-#   stage-splitting is a reshape to ``[pp, L/pp, ...]`` with the leading
-#   axis sharded over ``pp`` (each stage owns its layer slab; the existing
-#   ``scan_block`` machinery in ``models/llama.py`` shows the reshape).
-# * Schedule: ``shard_map`` over ``pp``; each stage scans its local
-#   ``L/pp`` layers and ``jax.lax.ppermute`` passes activations to the
-#   next stage.  Microbatching rides the existing grad-accumulation scan
-#   (``training/train_step.py``) — looping it over 2x pp microbatches
-#   yields the classic 1F1B-ish bubble fraction without new machinery.
-# * Placement: ``pp`` sits OUTERMOST (above dp_replicate) — stage
-#   boundaries are point-to-point transfers, the only traffic pattern that
-#   tolerates DCN latency; dense collectives stay on the inner ICI axes.
-# * Checkpoints are unaffected: Orbax stores global arrays, and the
-#   mesh-reshape restore tests prove resharding across layouts.
+# * The layer stack is a ``[L, ...]`` pytree scanned by one body — stage
+#   splitting shards the LEADING layer dim over ``pp`` (``shardings.
+#   default_rules(pipeline_parallel=True)``: ``"layers" -> (pp,)``), so
+#   each stage owns a contiguous ``L/pp`` slab and the per-layer scan
+#   becomes each stage's local scan.  Checkpoints keep the global
+#   ``[L, ...]`` shape, so restores reshard across pp layouts like any
+#   other mesh change.
+# * Schedule: the microbatch loop in the pipelined train step; stage
+#   compute is vmapped over the stage dim (``spmd_axis_name="pp"`` keeps
+#   FSDP/TP/SP activation rules applying unchanged inside a stage) and
+#   boundary activations (fwd) / activation-grads (bwd) move between
+#   neighbor stages via ``jax.lax.ppermute`` under ``shard_map``.
+# * Placement: ``pp`` sits OUTERMOST below ``dcn_dp`` (above the
+#   replicate axis) — stage boundaries are point-to-point transfers, the
+#   only traffic pattern that tolerates DCN latency; dense collectives
+#   stay on the inner ICI axes.
+# * Batches never shard over ``pp`` (every stage sees the full microbatch
+#   stream); only layer-stacked parameters and the schedule's boundary
+#   buffers name it.
 AXIS_DCN_DP = "dcn_dp"
 AXIS_PP = "pp"
 AXIS_DP_REPLICATE = "dp_replicate"
@@ -87,7 +92,7 @@ class MeshConfig:
     dcn_dp_size: int = 1      # slices over DCN (hierarchical DP, outermost)
     tp_size: int = 1
     cp_size: int = 1
-    pp_size: int = 1          # reserved seam — only 1 is implemented
+    pp_size: int = 1          # pipeline stages (training/pipeline.py)
     sequence_parallel: bool = False
     # Sequence layout over cp: "contiguous" | "zigzag" | None (None resolves
     # to zigzag when cp_size > 1 — the causal load-balanced default).
@@ -141,11 +146,6 @@ class MeshManager:
             if strict:
                 raise TypeError(msg)
             logger.warning(msg)
-        if _none_to(pp_size, 1) != 1:
-            raise NotImplementedError(
-                "pipeline parallelism is a reserved seam (pp axis exists, "
-                "size 1 only) — see the design note at the top of this "
-                "module")
         self.sequence_parallel = bool(sequence_parallel)
         # MoE expert placement: experts sharded over the tp axis (EP) vs
         # TP inside each expert — see ``shardings.default_rules``.
@@ -165,18 +165,21 @@ class MeshManager:
 
         tp_size = _none_to(tp_size, 1)
         cp_size = _none_to(cp_size, 1)
+        pp_size = _none_to(pp_size, 1)
         dp_replicate_size = _none_to(dp_replicate_size, 1)
         dcn_dp_size = _none_to(dcn_dp_size, 1)
         dp_size = _none_to(dp_size, None)
+        if pp_size < 1:
+            raise ValueError(f"pp_size must be >= 1, got {pp_size}")
         if dcn_dp_size < 1 or world % dcn_dp_size:
             raise ValueError(
                 f"device count {world} not divisible into "
                 f"dcn_dp_size={dcn_dp_size} slices")
         if dp_size is None:
-            denom = tp_size * cp_size
+            denom = tp_size * cp_size * pp_size
             if world % denom:
                 raise ValueError(
-                    f"world size {world} not divisible by tp*cp={denom}"
+                    f"world size {world} not divisible by tp*cp*pp={denom}"
                 )
             dp_size = world // denom
         # dp_size is the TOTAL data-parallel extent: dcn_dp (across slices)
@@ -187,15 +190,22 @@ class MeshManager:
                 f"dp_replicate_size {dcn_dp_size * dp_replicate_size}"
             )
         dp_shard = dp_size // (dcn_dp_size * dp_replicate_size)
-        total = dcn_dp_size * dp_replicate_size * dp_shard * cp_size * tp_size
+        total = (dcn_dp_size * pp_size * dp_replicate_size * dp_shard
+                 * cp_size * tp_size)
         if total != world:
             raise ValueError(
-                f"mesh {dcn_dp_size}x{dp_replicate_size}x{dp_shard}x"
-                f"{cp_size}x{tp_size}={total} != device count {world}"
+                f"mesh {dcn_dp_size}x{pp_size}x{dp_replicate_size}x"
+                f"{dp_shard}x{cp_size}x{tp_size}={total} != device count "
+                f"{world}"
             )
 
-        self.shape: Tuple[int, int, int, int, int] = (
+        # One entry per MESH_AXES name: (dcn_dp, pp, dp_replicate, dp_shard,
+        # cp, tp) — pp sits outermost below dcn_dp (the documented stage
+        # placement: boundary transfers are point-to-point, so they get the
+        # outermost ICI seam while dense collectives stay inner).
+        self.shape: Tuple[int, int, int, int, int, int] = (
             dcn_dp_size,
+            pp_size,
             dp_replicate_size,
             dp_shard,
             cp_size,
@@ -221,10 +231,7 @@ class MeshManager:
                 slab = np.asarray(slice_devs).reshape(inner_shape)
             slabs.append(slab)
         dev_array = np.stack(slabs, axis=0)
-        # the reserved pp axis rides along at size 1 (between dcn_dp and the
-        # replicate axis): specs that never name it see identical behavior
-        self.mesh_shape: Tuple[int, ...] = (
-            (dcn_dp_size, 1) + inner_shape)
+        self.mesh_shape: Tuple[int, ...] = self.shape
         self.mesh = Mesh(dev_array.reshape(self.mesh_shape), MESH_AXES)
 
     # -- reference-parity size accessors ----------------------------------
@@ -237,25 +244,29 @@ class MeshManager:
         return self.shape[0]
 
     @property
-    def dp_replicate_size(self) -> int:
+    def pp_size(self) -> int:
         return self.shape[1]
 
     @property
-    def dp_shard_size(self) -> int:
+    def dp_replicate_size(self) -> int:
         return self.shape[2]
 
     @property
-    def cp_size(self) -> int:
+    def dp_shard_size(self) -> int:
         return self.shape[3]
 
     @property
-    def tp_size(self) -> int:
+    def cp_size(self) -> int:
         return self.shape[4]
+
+    @property
+    def tp_size(self) -> int:
+        return self.shape[5]
 
     @property
     def dp_size(self) -> int:
         """TOTAL data-parallel extent: dcn_dp x dp_replicate x dp_shard."""
-        return self.shape[0] * self.shape[1] * self.shape[2]
+        return self.shape[0] * self.shape[2] * self.shape[3]
 
     @property
     def loss_reduce_size(self) -> int:
@@ -318,6 +329,7 @@ class MeshManager:
             dp_replicate_size=self.dp_replicate_size,
             tp_size=self.tp_size,
             cp_size=self.cp_size,
+            pp_size=self.pp_size,
             sequence_parallel=self.sequence_parallel,
             expert_parallel=self.expert_parallel,
             cp_layout=self.cp_layout,
@@ -382,6 +394,7 @@ class MeshManager:
             dp_replicate_size=self.dp_replicate_size,
             tp_size=self.tp_size,
             cp_size=self.cp_size,
+            pp_size=self.pp_size,
             sequence_parallel=self.sequence_parallel,
             expert_parallel=self.expert_parallel,
             cp_layout=self.cp_layout,

@@ -42,6 +42,7 @@ from automodel_tpu.distributed.mesh import (
     AXIS_DCN_DP,
     AXIS_DP_REPLICATE,
     AXIS_DP_SHARD,
+    AXIS_PP,
     AXIS_TP,
     BATCH_AXES,
     FSDP_AXES,
@@ -53,8 +54,10 @@ Rules = Dict[str, MeshAxes]
 
 
 def default_rules(sequence_parallel: bool = False,
-                  expert_parallel: bool = False) -> Rules:
-    """Logical-axis -> mesh-axes table for the FSDP(+HSDP)+TP+CP strategy.
+                  expert_parallel: bool = False,
+                  pipeline_parallel: bool = False) -> Rules:
+    """Logical-axis -> mesh-axes table for the FSDP(+HSDP)+TP+CP(+PP)
+    strategy.
 
     One table replaces the reference's per-model TP plan registry
     (``distributed/optimized_tp_plans.py:235-243``): model families share
@@ -66,10 +69,19 @@ def default_rules(sequence_parallel: bool = False,
     over ``tp`` (each tp shard owns E/tp experts, GShard-style EP) and keeps
     the intermediate unsharded — the dispatch/combine einsums then carry the
     cross-expert collectives.
+
+    ``pipeline_parallel``: stage splitting.  The stacked-layer dim of every
+    ``[L, ...]`` parameter shards over ``pp`` in contiguous blocks — each
+    stage owns its ``L/pp`` layer slab (the documented mesh.py seam design),
+    while non-stacked params (embedding, final norm, lm head) replicate
+    across ``pp``.  Checkpoints keep the global ``[L, ...]`` shape, so
+    restores reshard across pp layouts like any other mesh change.
     """
     rules: Rules = {
         # -- parameter axes --
-        "layers": None,                       # stacked-layer dim: never sharded
+        # stacked-layer dim: the pp stage seam when pipelining, else never
+        # sharded
+        "layers": (AXIS_PP,) if pipeline_parallel else None,
         "norm": None,
         "head_dim": None,
         "pos": None,
@@ -167,6 +179,23 @@ def batch_spec() -> P:
     the seq dim over ``cp`` (``distributed/cp_utils.py:102-149``).
     """
     return P(BATCH_AXES, AXIS_CP)
+
+
+def stage_boundary_spec(rules: Optional[Rules] = None) -> P:
+    """``[pp, B_mb, S, H]`` pipeline boundary-activation buffers: stage dim
+    over ``pp``, batch over the dp axes, sequence per the active ``act_seq``
+    rule (so SP's tp-sharded sequence layout survives the stage boundary),
+    model dim replicated.
+
+    This is the ONE spec the pipelined step's boundary ``ppermute`` wrapper
+    (``training/train_step.py``) commits its send/recv buffers to: the
+    ``shard_map`` around the permute is full-manual, so the buffer must be
+    constrained to a layout both sides agree on before it crosses the seam.
+    """
+    rules = rules if rules is not None else default_rules()
+    act = spec_for(("act_batch", "act_seq", "act_embed"), rules)
+    parts = list(act) + [None] * (3 - len(act))
+    return P(AXIS_PP, *parts)
 
 
 def batch_shardings(mesh: Mesh, batch: Optional[Any] = None) -> Any:
@@ -310,6 +339,10 @@ class ParallelPlan:
     # the attention dispatcher via sharding_context and by shard_batch (the
     # host-side permutation in ops/zigzag.py).
     cp_layout: str = "contiguous"
+    # Pipeline stages (mesh ``pp`` extent): > 1 means the plan's rules shard
+    # the stacked-layer dim over pp and the train step must run the
+    # pipelined 1F1B/GPipe schedule (training/train_step.py).
+    pp_size: int = 1
 
     def shard_params(self, params: Any) -> Any:
         return jax.device_put(params, self.param_sharding)
@@ -358,8 +391,13 @@ def build_parallel_plan(
             cp_layout = getattr(mesh_manager, "cp_layout", None)
     else:
         mesh = mesh_manager
+    # A >1 pp extent on the mesh IS the pipeline request: the stacked-layer
+    # dim must shard over it or every stage would hold (and optimize) the
+    # full depth while the schedule ran only its slab.
+    pp_size = int(dict(mesh.shape).get(AXIS_PP, 1))
     rules = rules if rules is not None else default_rules(
-        bool(sequence_parallel), bool(expert_parallel))
+        bool(sequence_parallel), bool(expert_parallel),
+        pipeline_parallel=pp_size > 1)
     specs = param_partition_specs(model, rules)
     shardings = to_named_shardings(mesh, specs)
     return ParallelPlan(
@@ -369,4 +407,5 @@ def build_parallel_plan(
         param_sharding=shardings,
         batch_sharding=NamedSharding(mesh, batch_spec()),
         cp_layout=resolve_cp_layout(cp_layout, mesh.shape.get(AXIS_CP, 1)),
+        pp_size=pp_size,
     )

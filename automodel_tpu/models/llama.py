@@ -107,6 +107,16 @@ def llama3_2_1b_config() -> "LlamaConfig":
 class LlamaForCausalLM:
     """Functional model: ``init`` builds the param pytree, ``__call__`` applies it."""
 
+    # Pipeline-parallel stage splitting is valid for this family: the
+    # forward is embed -> uniform layer scan -> norm/head, so the pipelined
+    # step (``training/pipeline.py``) can replay it split at layer-slab
+    # boundaries.  Families whose forward consumes the stream differently
+    # (sequence classification's last-token pooling, VLM feature merges,
+    # Gemma/DeepSeek/GPT-2's own loops) MUST NOT inherit True — the gate
+    # also rejects any subclass that overrides ``forward_embeds``, and MoE
+    # aux losses are rejected at trace time.
+    pp_safe = True
+
     def __init__(
         self,
         config: LlamaConfig,

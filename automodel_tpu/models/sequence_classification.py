@@ -31,6 +31,11 @@ class ForSequenceClassification:
     # (ops/zigzag.py) that slot no longer holds the last token, so the
     # recipes keep cp runs of this wrapper on the contiguous layout.
     zigzag_cp_safe = False
+    # Last-token pooling is also why this wrapper is pipeline-UNSAFE: the
+    # pipelined step's last stage computes an lm-head token loss, not a
+    # pooled classification head — ``pp_size > 1`` is rejected loudly
+    # (``training/pipeline.py::ensure_pp_compatible``).
+    pp_safe = False
 
     def __init__(self, backbone, num_labels: int,
                  pad_token_id: Optional[int] = None):

@@ -231,6 +231,25 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                         seed=int(rng_cfg.get("seed", 42)) if rng_cfg else 42,
                         ranked=bool(rng_cfg.get("ranked", False)) if rng_cfg else False))
 
+        # Pipeline parallelism (``pipeline:`` YAML block): resolved BEFORE
+        # the mesh so ``pipeline.pp_size`` can size the pp axis when
+        # ``distributed.pp_size`` is unset (both set and disagreeing is a
+        # config error — one mesh, one schedule).
+        from automodel_tpu.config.loader import normalize_null_spelling
+        from automodel_tpu.training.pipeline import build_pipeline_config
+
+        self.pipeline_config = build_pipeline_config(cfg.get("pipeline"))
+        if self.pipeline_config.pp_size > 1:
+            existing = normalize_null_spelling(cfg.get("distributed.pp_size"))
+            if existing is None:
+                cfg.set_by_dotted("distributed.pp_size",
+                                  self.pipeline_config.pp_size)
+            elif int(existing) != self.pipeline_config.pp_size:
+                raise ValueError(
+                    f"pipeline.pp_size={self.pipeline_config.pp_size} "
+                    f"disagrees with distributed.pp_size={existing} — set "
+                    "one of them (they must size the same pp axis)")
+
         # Mesh
         dist_cfg = cfg.get("distributed")
         if isinstance(dist_cfg, ConfigNode) and "_target_" in dist_cfg:
@@ -238,6 +257,7 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
         else:
             kwargs = dist_cfg.to_dict() if dist_cfg is not None else {}
             self.mesh_manager = MeshManager(**kwargs)
+        self._apply_pipeline_policy()
 
         # Model + plan (cp layout policy needs the model: families can opt
         # out of the zig-zag permutation via ``zigzag_cp_safe = False``)
@@ -339,6 +359,11 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
             import jax.numpy as jnp
 
             step_kwargs["grad_dtype"] = jnp.dtype(str(tr_cfg.get("grad_dtype")))
+        if (self.mesh_manager.pp_size > 1
+                or cfg.get("pipeline") is not None):
+            # the pipelined (or degenerate-split) step; pp-unsafe models
+            # (seqcls pooling, VLMs, MoE aux) fail HERE, loudly, at setup
+            step_kwargs["pipeline"] = self.pipeline_config
         self.step_fns = build_train_step(
             self.model, self.optimizer, loss_fn=self.loss_fn, plan=self.plan,
             trainable_mask=step_mask, **step_kwargs)
@@ -538,6 +563,70 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                         " (sort-based grouped matmuls)"
                         if dispatch == "sorted" else
                         " (GShard one-hot dispatch/combine oracle)")
+
+    def _apply_pipeline_policy(self):
+        """Reconcile the ``pipeline:`` block with the built mesh and check
+        the batch arithmetic BEFORE any step is built.
+
+        ``distributed.pp_size > 1`` without a ``pipeline:`` block gets the
+        default schedule (1f1b, k = pp).  The divisibility contract is
+        validated here with the numbers spelled out: the global batch must
+        split into ``num_microbatches`` equal dp-shardable groups
+        (``training/pipeline.py::validate_pipeline_batch``), and each
+        grad-accumulation microbatch's ``local_batch_size`` must split into
+        ``num_microbatches`` pipeline rows."""
+        import dataclasses as _dc
+
+        from automodel_tpu.training.pipeline import validate_pipeline_batch
+        from automodel_tpu.training.timers import pp_bubble_fraction
+
+        pp = self.mesh_manager.pp_size
+        self._pp_bubble = None
+        if pp <= 1:
+            # the degenerate (pp=1) microbatch split still needs the
+            # divisibility contract enforced at SETUP, not at first trace
+            k = self.pipeline_config.resolved_microbatches()
+            if k > 1:
+                ss = self.cfg.get("step_scheduler")
+                local_bs = int(ss.get("local_batch_size", 1)) if ss else 1
+                if local_bs % k:
+                    raise ValueError(
+                        f"pipeline: step_scheduler.local_batch_size="
+                        f"{local_bs} is not divisible by "
+                        f"pipeline.num_microbatches={k} — the microbatch "
+                        "split needs equal dp-shardable groups even on a "
+                        "pp=1 mesh")
+            return
+        if self.pipeline_config.pp_size == 1:
+            # distributed.pp_size sized the axis: adopt it, KEEPING any
+            # explicit schedule/num_microbatches knobs from the pipeline:
+            # block (replacing the whole config would silently drop them)
+            self.pipeline_config = _dc.replace(self.pipeline_config,
+                                               pp_size=pp)
+        k = self.pipeline_config.resolved_microbatches()
+        dp = self.mesh_manager.dp_size
+        ss = self.cfg.get("step_scheduler")
+        gbs = ss.get("global_batch_size") if ss is not None else None
+        if gbs is not None:
+            validate_pipeline_batch(int(gbs), k, dp)
+        local_bs = int(ss.get("local_batch_size", 1)) if ss else 1
+        if local_bs % k:
+            raise ValueError(
+                f"pipeline: step_scheduler.local_batch_size={local_bs} is "
+                f"not divisible by pipeline.num_microbatches={k} — each "
+                "grad-accumulation microbatch (local_batch_size x dp rows) "
+                "must split into num_microbatches equal dp-shardable "
+                "pipeline microbatches; raise local_batch_size or lower "
+                "num_microbatches")
+        self._pp_bubble = pp_bubble_fraction(
+            pp, k, self.pipeline_config.schedule)
+        if self.dist_info.is_main:
+            logger.info(
+                "pipeline parallelism: pp=%d, schedule %r, "
+                "num_microbatches=%d (bubble fraction %.3f — "
+                "warmup+cooldown idle over step wall; raise "
+                "num_microbatches to shrink it)",
+                pp, self.pipeline_config.schedule, k, self._pp_bubble)
 
     # -- overridable setup hooks (the VLM recipe swaps these) ---------------
     def _build_freeze_mask(self):
@@ -1111,16 +1200,24 @@ class TrainFinetuneRecipeForNextTokenPrediction(BaseRecipe):
                     {"ckpt_stall":
                      elapsed.get("ckpt_stall", 0.0) * prof.log_interval},
                     window)
+                # pipeline bubble: schedule-derived warmup+cooldown idle
+                # over step wall (training/timers.py::pp_bubble_fraction),
+                # logged each window so the pp=​k trade-off stays visible
+                bubble = getattr(self, "_pp_bubble", None)
                 logger.info(
-                    "step %d | time (ms)%s%s", step,
+                    "step %d | time (ms)%s%s%s", step,
                     "".join(f" | {n}: {v * 1e3:.2f}"
                             for n, v in elapsed.items()),
                     (f" | ckpt_stall_fraction: {frac:.4f}"
-                     if "ckpt_stall" in elapsed else ""))
+                     if "ckpt_stall" in elapsed else ""),
+                    (f" | pp_bubble_fraction: {bubble:.4f}"
+                     if bubble is not None else ""))
                 if self.wandb is not None:
                     log = {f"timers/{n}": v for n, v in elapsed.items()}
                     if "ckpt_stall" in elapsed:
                         log["timers/ckpt_stall_fraction"] = frac
+                    if bubble is not None:
+                        log["timers/pp_bubble_fraction"] = bubble
                     self.wandb.log(log, step=step)
         if is_val:
             self.flush_metrics()

@@ -109,6 +109,33 @@ def restore_time_by_source(elapsed: Dict[str, float]) -> Dict[str, float]:
             for name in RESTORE_TIMERS}
 
 
+# Pipeline-parallel bubble accounting (training/pipeline.py): every
+# optimizer step's microbatch loop runs ``k + warmup`` slots per
+# grad-accumulation microbatch, of which ``warmup`` (the fill) plus the
+# mirror-image drain in the backward are idle on any given stage.
+def pp_bubble_fraction(pp_size: int, num_microbatches: int,
+                       schedule: str = "1f1b") -> float:
+    """Warmup+cooldown idle fraction of the pipelined step's wall time.
+
+    Schedule-derived and exact for equal-cost microbatches: a stage is busy
+    for ``k`` of the ``k + stride*(pp-1)`` slots of each pipeline pass
+    (fwd and bwd passes have the same shape under AD, so the per-step
+    fraction equals the per-pass fraction).  ``stride`` is 1 for ``gpipe``
+    and 2 for ``1f1b`` (the double-buffered boundary trades one extra
+    warmup/cooldown slot pair per stage for permute/compute overlap).
+    Logged per profiling window when pp > 1 and reported by the bench
+    ``pipeline`` secondary; drive it toward 0 by raising
+    ``pipeline.num_microbatches``.
+    """
+    if pp_size <= 1:
+        return 0.0
+    from automodel_tpu.training.pipeline import schedule_slots
+
+    num_slots, warmup, _ = schedule_slots(pp_size, num_microbatches,
+                                          schedule)
+    return warmup / num_slots
+
+
 @dataclasses.dataclass
 class ProfilingConfig:
     """``profiling:`` YAML section — wires :class:`Timers` into the hot loop.

@@ -29,13 +29,25 @@ import optax
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from automodel_tpu.distributed.mesh import AXIS_PP
 from automodel_tpu.distributed.shardings import (
     ParallelPlan,
     sharding_context,
+    stage_boundary_spec,
     state_partition_specs,
     to_named_shardings,
 )
 from automodel_tpu.loss.masked_ce import IGNORE_INDEX, MaskedCrossEntropy
+from automodel_tpu.training.pipeline import (
+    PipelineConfig,
+    PIPELINE_BATCH_KEYS,
+    ensure_pp_compatible,
+    schedule_slots,
+    split_microbatches,
+    stage_embed,
+    stage_head_loss,
+    run_stage_layers,
+)
 
 # Keys the model forward consumes; models with extra modalities extend this
 # via an ``extra_batch_keys`` attribute (e.g. Qwen2.5-VL's image_grid_thw).
@@ -99,6 +111,282 @@ def _microbatch_loss(model, loss_fn, params, mb: Dict[str, jnp.ndarray]):
     return loss
 
 
+# ---------------------------------------------------------------------------
+# Pipelined microbatch loss (pp > 1): the 1F1B/GPipe schedule
+# ---------------------------------------------------------------------------
+def _make_pp_shift(mesh, boundary_spec, pp: int):
+    """The stage-boundary send: ``[pp, B_mb, S, H]`` buffers move one stage
+    forward (``s -> s+1``) via ``jax.lax.ppermute`` under a FULL-MANUAL
+    ``shard_map`` — the one place activations (fwd) and, through the AD
+    transpose, activation-grads (bwd) cross the ``pp`` seam.  The buffer is
+    constrained to ``boundary_spec`` by the caller, so the shard_map neither
+    reshards on entry nor exit; the permute is the only traffic.
+
+    This is also the census anchor: the ``pp2xdp2`` golden census pins these
+    ppermutes keyed to the ``pp`` axis, and lint rule L007 keeps raw
+    ``ppermute`` construction confined to ``ops/`` and this module so the
+    census can always name the home of every permute it counts.
+    """
+    from jax import lax as _lax
+
+    from automodel_tpu.utils.jax_compat import shard_map
+
+    perm = [(i, i + 1) for i in range(pp - 1)]
+
+    def _shift(y_local):
+        return _lax.ppermute(y_local, AXIS_PP, perm)
+
+    return shard_map(_shift, mesh, in_specs=boundary_spec,
+                     out_specs=boundary_spec)
+
+
+def _build_pipeline_loss(model, loss_fn, plan: ParallelPlan,
+                         pipeline: PipelineConfig):
+    """``fn(params, mb) -> loss_sum`` for ONE grad-accumulation microbatch
+    (``mb`` = dict of ``[B, S]`` arrays), pipelined over the mesh's ``pp``
+    axis with ``pipeline.num_microbatches`` microbatches.
+
+    Execution (see ``training/pipeline.py`` for the design):
+      * the layer slab ``[L, ...]`` (sharded over pp) is viewed as
+        ``[pp, L/pp, ...]`` and stage compute is vmapped over the stage dim
+        (``spmd_axis_name="pp"`` keeps FSDP/TP/SP constraints inside a
+        stage working unchanged — PR-10 qdot and the quant plumbing ride
+        along because the stage body calls the same ``_decoder_layer``);
+      * a rolled loop of ``num_slots`` iterations runs
+        warmup/steady/cooldown; boundary activations move via
+        :func:`_make_pp_shift`; under the ``1f1b`` schedule the shift for
+        microbatch ``m+1`` is issued while stage compute for ``m`` runs
+        (double-buffered boundary: the permute has no data dependency on
+        the slot's compute);
+      * the last stage's output runs final-norm + lm-head + sum-CE; slots
+        still in warmup are masked out of the accumulator (their inputs
+        are clamped REAL microbatches, so no NaN can leak through the
+        mask's cotangent).
+    """
+    import jax.numpy as _jnp
+    from jax import lax as _lax
+    from jax.sharding import NamedSharding as _NS
+
+    mesh = plan.mesh
+    pp = plan.pp_size
+    k = pipeline.resolved_microbatches()
+    num_slots, warmup, stride = schedule_slots(pp, k, pipeline.schedule)
+    boundary_spec = stage_boundary_spec(plan.rules)
+    boundary_sh = _NS(mesh, boundary_spec)
+    pp_shift = _make_pp_shift(mesh, boundary_spec, pp)
+    L = model.config.num_hidden_layers
+    if L % pp:
+        raise ValueError(
+            f"pipeline: num_hidden_layers={L} is not divisible by "
+            f"pp_size={pp} — stages must hold equal layer slabs")
+
+    layer_specs = plan.param_specs["layers"]
+
+    def _to_stage_slab(leaf, spec):
+        # [L, ...] -> [pp, L/pp, ...]; the leading block-sharded layer dim
+        # splits locally (each device's slab reshapes to [1, L/pp, ...]).
+        st = leaf.reshape(pp, L // pp, *leaf.shape[1:])
+        parts = list(spec)
+        new_spec = P(parts[0] if parts else AXIS_PP, None, *parts[1:])
+        return _lax.with_sharding_constraint(st, _NS(mesh, new_spec))
+
+    from automodel_tpu.distributed.shardings import spec_for
+
+    def _c(x, spec_parts):
+        """Pin an intermediate to an explicit layout.  GSPMD left to itself
+        propagates stage shardings BACKWARD into the loop-invariant
+        microbatch stacks, which then reshard every slot (involuntary
+        remats, and — the census pin violation — all-gathers over pp), so
+        every per-slot tensor is constrained at its definition."""
+        return _lax.with_sharding_constraint(x, _NS(mesh, P(*spec_parts)))
+
+    tok_spec = tuple(spec_for(("act_batch", "act_seq_nosp"), plan.rules))
+
+    def pipeline_loss(params, mb):
+        unconsumed = set(mb) - set(PIPELINE_BATCH_KEYS)
+        if unconsumed:
+            raise ValueError(
+                f"pipeline: batch keys {sorted(unconsumed)} are not "
+                f"consumed by the pipelined step (accepts "
+                f"{sorted(PIPELINE_BATCH_KEYS)}) — model families needing "
+                "other modalities are pp-unsafe (see training/pipeline.py).")
+        mbs = split_microbatches(mb, k)
+        # The stacked [k, B/k, S] microbatch arrays stay pp-REPLICATED
+        # (batch over dp, seq over cp, never pp) for the whole loop.
+        mbs = {key: _c(v, (None,) + tok_spec) for key, v in mbs.items()}
+        ids, labels = mbs["input_ids"], mbs["labels"]
+        b, S = ids.shape[1], ids.shape[2]
+        pos = mbs.get("position_ids")
+        if pos is None:
+            pos = _c(_jnp.broadcast_to(
+                _jnp.arange(S, dtype=_jnp.int32), (k, b, S)),
+                (None,) + tok_spec)
+        sides = {"position_ids": pos}
+        for key in ("segment_ids", "attention_mask"):
+            if key in mbs:
+                sides[key] = mbs[key]
+
+        slab = jax.tree.map(_to_stage_slab, params["layers"], layer_specs)
+        stage_ids = _jnp.arange(pp, dtype=_jnp.int32)
+        mask0 = (stage_ids == 0)[:, None, None, None]
+
+        # All k microbatch embeddings are computed ONCE, before the slot
+        # loop, exactly like the dense step would (the FSDP-sharded table's
+        # lookup resolves its dp_shard conflict with dp_shard gathers,
+        # outside the loop and with no pp in sight); per slot the stages
+        # just SELECT their row — a local index into a pp-replicated
+        # buffer.  An in-loop lookup instead hands GSPMD a per-slot
+        # table/index sharding conflict that it resolves by resharding
+        # across pp (the all-gather-over-pp class the census pins to zero).
+        ids_flat = _c(ids.reshape(k * b, S), tok_spec)
+        embs = stage_embed(model, params, ids_flat)
+        embs = _c(embs.reshape(k, b, S, embs.shape[-1]),
+                  (None,) + tuple(boundary_spec)[1:])
+
+        # The slot body runs the layer slab, head and loss vmapped over the
+        # stage dim — everything [pp, ...]-sharded, so the only cross-pp
+        # traffic is the boundary ppermute plus the tiny all-reduces AD
+        # inserts for the pp-broadcast head params.  (Per-stage head
+        # compute costs nothing extra: pp-replicated compute would run the
+        # identical FLOPs on every device anyway.)  Each stage's head
+        # result is masked off except on the last stage; its inputs are
+        # clamped REAL microbatches, so no NaN can leak through the mask's
+        # cotangent.
+        def _staged(slab_s, x_s, sides_s, sid, lbl):
+            y = run_stage_layers(model, slab_s, x_s,
+                                 sides_s["position_ids"],
+                                 sides_s.get("segment_ids"),
+                                 sides_s.get("attention_mask"))
+            loss_s = stage_head_loss(model, loss_fn, params, y, lbl)
+            return y, _jnp.where(sid == pp - 1,
+                                 loss_s.astype(_jnp.float32), 0.0)
+
+        _staged_v = jax.vmap(_staged, in_axes=(0, 0, 0, 0, None),
+                             spmd_axis_name=AXIS_PP)
+
+        def staged(slab_a, x_a, sides_a, sids_a, lbl_a):
+            y, losses = _staged_v(slab_a, x_a, sides_a, sids_a, lbl_a)
+            # the carry's sharding must be pinned: an unconstrained scan
+            # carry lets the while-loop pick a layout that mismatches the
+            # body's, resharding (over pp!) every slot
+            return (_lax.with_sharding_constraint(y, boundary_sh),
+                    _lax.with_sharding_constraint(losses,
+                                                  _NS(mesh, P(AXIS_PP))))
+
+        def _embs_at(ts):
+            # [pp, B_mb, S, H]: the entry embedding each stage would start
+            # at slot ts (only stage 0's is consumed; clamping keeps the
+            # rest real data so masked branches stay finite)
+            m = _jnp.clip(ts - stride * stage_ids, 0, k - 1)
+            return _lax.with_sharding_constraint(embs[m], boundary_sh)
+
+        def _sides_at(t):
+            m = _jnp.clip(t - stride * stage_ids, 0, k - 1)   # [pp]
+            return jax.tree.map(
+                lambda a: _c(a[m], (AXIS_PP,) + tok_spec), sides)
+
+        def _label_at(t):
+            m_out = t - warmup
+            return _c(_lax.dynamic_index_in_dim(
+                labels, _jnp.clip(m_out, 0, k - 1), 0, keepdims=False),
+                tok_spec)
+
+        zero_buf = _lax.with_sharding_constraint(
+            _jnp.zeros((pp, b, S, model.config.hidden_size),
+                       model.compute_dtype), boundary_sh)
+
+        if pipeline.schedule == "1f1b":
+            # Double-buffered boundary: the shift of slot t's carry (the
+            # activations stage s computed at t-1) is issued at the TOP of
+            # slot t, while slot t's compute consumes the ALREADY-received
+            # x_cur — no data dependency between the permute and the
+            # compute, so XLA overlaps them (one extra warmup/cooldown slot
+            # pair per stage buys the overlap; stage stride 2).
+            def slot(carry, t):
+                x_cur, y_prev, acc = carry
+                x_recv = pp_shift(y_prev)
+                y, losses = staged(slab, x_cur, _sides_at(t), stage_ids,
+                                   _label_at(t))
+                x_next = _lax.with_sharding_constraint(
+                    _jnp.where(mask0, _embs_at(t + 1), x_recv), boundary_sh)
+                acc = acc + _jnp.where(t - warmup >= 0,
+                                       _jnp.sum(losses), 0.0)
+                return (x_next, y, acc), None
+
+            x0 = _lax.with_sharding_constraint(
+                _jnp.where(mask0, _embs_at(0), zero_buf), boundary_sh)
+            init = (x0, zero_buf, _jnp.float32(0.0))
+            (_, _, total), _ = _lax.scan(slot, init,
+                                         _jnp.arange(num_slots))
+        else:  # gpipe: synchronous boundary (permute -> compute dependency)
+            def slot(carry, t):
+                y_prev, acc = carry
+                x_recv = pp_shift(y_prev)
+                buf = _lax.with_sharding_constraint(
+                    _jnp.where(mask0, _embs_at(t), x_recv), boundary_sh)
+                y, losses = staged(slab, buf, _sides_at(t), stage_ids,
+                                   _label_at(t))
+                acc = acc + _jnp.where(t - warmup >= 0,
+                                       _jnp.sum(losses), 0.0)
+                return (y, acc), None
+
+            init = (zero_buf, _jnp.float32(0.0))
+            (_, total), _ = _lax.scan(slot, init, _jnp.arange(num_slots))
+        return total
+
+    return pipeline_loss
+
+
+def _build_degenerate_pipeline_loss(model, loss_fn, k: int):
+    """The pp == 1 pipeline: no stages, no permutes — just the microbatch
+    split.  At ``k == 1`` this is LITERALLY the dense microbatch body (same
+    call graph, bitwise-identical step); ``k > 1`` sums the split's
+    sub-losses (same math, float re-association only).
+
+    ``dropout_rng`` is a per-grad-accum-microbatch KEY, not a batch-row
+    array — it must never ride the row split (reshaping its (2,) key data
+    would mangle the key).  Each sub-microbatch instead folds its index
+    into the group's key, so LoRA dropout masks stay decorrelated across
+    the split."""
+    from jax import lax as _lax
+
+    import jax.numpy as _jnp
+
+    def loss(params, mb):
+        if k == 1:
+            return _microbatch_loss(model, loss_fn, params, mb)
+        # Same key gate as the pp>1 path: the split reshapes dim 0 as batch
+        # ROWS, which is only true for the token-stream keys — a VLM's
+        # pixel_values/image_grid_thw lead with image counts, and silently
+        # row-splitting those would re-pair images with the wrong text.
+        unconsumed = set(mb) - set(PIPELINE_BATCH_KEYS) - {"dropout_rng"}
+        if unconsumed:
+            raise ValueError(
+                f"pipeline: batch keys {sorted(unconsumed)} are not "
+                "row-splittable by the microbatch split (accepts "
+                f"{sorted(PIPELINE_BATCH_KEYS)} + dropout_rng) — model "
+                "families needing other modalities cannot use "
+                "pipeline.num_microbatches > 1 (see training/pipeline.py).")
+        rng_data = mb.get("dropout_rng")
+        mbs = split_microbatches(
+            {key: v for key, v in mb.items() if key != "dropout_rng"}, k)
+
+        def micro_k(acc, args):
+            sub, i = args
+            if rng_data is not None:
+                sub = dict(sub)
+                sub["dropout_rng"] = jax.random.key_data(jax.random.fold_in(
+                    jax.random.wrap_key_data(rng_data), i))
+            return acc + _microbatch_loss(model, loss_fn, params,
+                                          sub).astype(_jnp.float32), None
+
+        total, _ = _lax.scan(micro_k, _jnp.float32(0.0),
+                             (mbs, _jnp.arange(k)))
+        return total
+
+    return loss
+
+
 @dataclasses.dataclass
 class TrainStepFns:
     """Compiled step functions + the state shardings they were built with."""
@@ -113,6 +401,11 @@ class TrainStepFns:
     # the position vectors the ring derives per shard.
     cp_layout: str = "contiguous"
     cp_size: int = 1
+    # Pipeline metadata (logging / bench / bubble accounting); pp_size 1
+    # means the dense step (possibly with a degenerate microbatch split).
+    pp_size: int = 1
+    pp_schedule: Optional[str] = None
+    pp_num_microbatches: Optional[int] = None
 
     def shard_batch(self, stacked: Dict[str, Any],
                     process_local: bool = False) -> Dict[str, Any]:
@@ -217,6 +510,7 @@ def build_train_step(
     plan: Optional[ParallelPlan] = None,
     grad_dtype: Any = jnp.float32,
     trainable_mask: Optional[Any] = None,
+    pipeline: Optional[PipelineConfig] = None,
 ) -> TrainStepFns:
     """Build jitted ``train_step(params, opt_state, batch) ->
     (params, opt_state, metrics)`` and ``eval_step(params, batch) -> metrics``.
@@ -231,6 +525,16 @@ def build_train_step(
     per step vs masking the optimizer, and it is what allows a
     non-differentiable (e.g. int8 weight-only quantized) frozen base.
     ``tx`` must be UNMASKED in this mode; frozen leaves are closed over.
+
+    ``pipeline`` (:class:`~automodel_tpu.training.pipeline.PipelineConfig`):
+    when the plan's mesh has ``pp > 1`` the per-A-microbatch loss runs the
+    pipelined 1F1B/GPipe schedule (stage-sharded layer slab, boundary
+    ``ppermute``s — see ``_build_pipeline_loss``) INSIDE the same step:
+    grad accumulation, per-token normalization, clipping, the optimizer
+    update and the quantized-compute plumbing are all shared with the dense
+    path.  A pp=1 mesh with an explicit ``pipeline`` runs the degenerate
+    schedule (microbatch split only; ``num_microbatches=1`` is bitwise the
+    dense step).
     """
     loss_fn = loss_fn if loss_fn is not None else MaskedCrossEntropy()
     # Loss contract (typed, not by accident): a loss object must carry
@@ -258,6 +562,38 @@ def build_train_step(
     else:
         ctx = contextlib.nullcontext
 
+    # Pipeline routing: a >1 pp extent on the plan's mesh selects the
+    # pipelined microbatch loss; the schedule knobs come from ``pipeline``
+    # (defaulting to 1f1b with k = pp microbatches).
+    pp_size = int(getattr(plan, "pp_size", 1)) if plan is not None else 1
+    if pipeline is not None and pipeline.pp_size > 1:
+        if plan is None:
+            raise ValueError(
+                "pipeline.pp_size > 1 needs a ParallelPlan built on a mesh "
+                "whose pp axis matches — the pipelined step cannot run "
+                "unsharded")
+        if pipeline.pp_size != pp_size:
+            raise ValueError(
+                f"pipeline.pp_size={pipeline.pp_size} disagrees with the "
+                f"mesh's pp extent {pp_size} (distributed.pp_size) — size "
+                "the mesh and the schedule identically")
+    if pp_size > 1:
+        if pipeline is None:
+            pipeline = PipelineConfig(pp_size=pp_size)
+        elif pipeline.pp_size == 1:
+            # an explicit config that only picks schedule knobs: adopt the
+            # mesh's pp (mirrors the recipe's _apply_pipeline_policy) so
+            # num_microbatches resolves against the REAL stage count
+            # instead of silently running k=1
+            pipeline = dataclasses.replace(pipeline, pp_size=pp_size)
+        ensure_pp_compatible(model, loss_fn, trainable_mask)
+        mb_loss = _build_pipeline_loss(model, loss_fn, plan, pipeline)
+    elif pipeline is not None:
+        mb_loss = _build_degenerate_pipeline_loss(
+            model, loss_fn, pipeline.resolved_microbatches())
+    else:
+        mb_loss = functools.partial(_microbatch_loss, model, loss_fn)
+
     def count_label_tokens(labels):
         return jnp.sum(labels != IGNORE_INDEX).astype(jnp.float32)
 
@@ -278,8 +614,7 @@ def build_train_step(
         trainable, frozen = split_params(params)
 
         def loss_of(tr, mb):
-            return _microbatch_loss(model, loss_fn, join_params(tr, frozen),
-                                    mb)
+            return mb_loss(join_params(tr, frozen), mb)
 
         grad_fn = jax.value_and_grad(loss_of)
 
@@ -319,7 +654,7 @@ def build_train_step(
         num_label_tokens = count_label_tokens(batch["labels"])
 
         def micro(loss_acc, mb):
-            return loss_acc + _microbatch_loss(model, loss_fn, params, mb), None
+            return loss_acc + mb_loss(params, mb), None
 
         with ctx():
             total, _ = jax.lax.scan(micro, jnp.float32(0.0), batch)
@@ -375,13 +710,22 @@ def build_train_step(
                             opt_sharding, mb_sharding,
                             cp_layout=getattr(plan, "cp_layout",
                                               "contiguous"),
-                            cp_size=int(dict(mesh.shape).get("cp", 1)))
+                            cp_size=int(dict(mesh.shape).get("cp", 1)),
+                            pp_size=pp_size,
+                            pp_schedule=(pipeline.schedule
+                                         if pipeline is not None else None),
+                            pp_num_microbatches=(
+                                pipeline.resolved_microbatches()
+                                if pipeline is not None else None))
 
     return TrainStepFns(
         jax.jit(train_step, donate_argnums=(0, 1)),
         jax.jit(eval_step),
         jax.jit(init_opt),
         None, None,
+        pp_schedule=(pipeline.schedule if pipeline is not None else None),
+        pp_num_microbatches=(pipeline.resolved_microbatches()
+                             if pipeline is not None else None),
     )
 
 

@@ -182,6 +182,19 @@ SECONDARY = {
     # requests_s plus serve_p50_ms / serve_p99_ms end-to-end latency as
     # extra secondary keys.
     "serve": [],
+    # Pipeline-parallel leg (docs/guides/distributed.md "Pipeline
+    # parallelism"; BENCH_PP=0 skips): handled by _pipeline_secondary_main
+    # on the multichip dryrun mesh (pp2 x dp2 x tp2 over 8 virtual CPU
+    # devices — one chip cannot host a stage boundary).  Reports pp=2
+    # 1F1B tok/s, with _vs_baseline = pp2 tok/s / dense pp1 tok/s on the
+    # same device count, plus ``pp_bubble_fraction`` (the schedule's
+    # warmup+cooldown idle over step wall — training/timers.py).  On
+    # virtual CPU devices the ratio mostly shows the bubble + permute
+    # overhead (every "device" shares one CPU, so pipelining buys no
+    # wall-clock); on a real pod slice it is the end-to-end pipelining
+    # cost/benefit number.  ``BENCH_PP_MICROBATCHES`` sets k (default 4);
+    # ``BENCH_PP_SCHEDULE`` pins 1f1b|gpipe.
+    "pipeline": [],
     # Checkpoint-stall leg: handled by _ckpt_secondary_main — times a
     # training window containing saves under checkpoint.async_save true vs
     # false through the real recipe save path.  Reports the mean per-save
@@ -358,6 +371,88 @@ def _cp_secondary_main() -> None:
     zig = run("zigzag")
     print(json.dumps({"tps": round(zig, 1),
                       "vs_baseline": round(zig / contig, 4)}))
+
+
+def _pipeline_secondary_main() -> None:
+    """Child process: the pipeline-parallel leg on the multichip dryrun
+    mesh (pp2 x dp2 x tp2 over 8 virtual CPU devices).
+
+    Times the REAL jitted pipelined train step (stage-sharded layer slab,
+    1F1B boundary permutes, k microbatches per grad-acc microbatch) on the
+    tiny flagship vs the dense step at pp=1 on the same device count and
+    batch.  Absolute tok/s on virtual CPU devices is not chip-meaningful;
+    the pp2/pp1 RATIO (the leg's vs_baseline) tracks schedule overhead,
+    and ``pp_bubble_fraction`` reports the schedule-derived idle the ratio
+    should converge to as k grows.  ``BENCH_PP=0`` skips;
+    ``BENCH_PP_MICROBATCHES`` sets k; ``BENCH_PP_SCHEDULE`` pins the
+    schedule.
+    """
+    if os.environ.get("BENCH_PP", "1") == "0":
+        raise SystemExit("BENCH_PP=0: pipeline leg skipped")
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import __graft_entry__ as graft
+    from automodel_tpu.distributed.mesh import MeshManager
+    from automodel_tpu.distributed.shardings import build_parallel_plan
+    from automodel_tpu.loss.masked_ce import IGNORE_INDEX, MaskedCrossEntropy
+    from automodel_tpu.optim import build_optimizer
+    from automodel_tpu.training.pipeline import PipelineConfig
+    from automodel_tpu.training.timers import pp_bubble_fraction
+    from automodel_tpu.training.train_step import build_train_step
+
+    schedule = os.environ.get("BENCH_PP_SCHEDULE", "1f1b")
+    k = int(os.environ.get("BENCH_PP_MICROBATCHES", "4"))
+    steps, warmup = (2, 1) if SMALL else (3, 1)
+    model = graft._flagship(tiny=True)
+    rng = np.random.default_rng(0)
+    B, S = 2 * k, 512 if not SMALL else 256
+    ids = rng.integers(0, 255, (1, B, S))              # [A=1, B, S]
+    labels = np.roll(ids, -1, -1)
+    labels[..., -1] = IGNORE_INDEX
+    stacked = {"input_ids": ids.astype(np.int32),
+               "labels": labels.astype(np.int32)}
+
+    def run(pp: int) -> float:
+        if pp > 1:
+            mm = MeshManager(pp_size=pp, dp_size=2, tp_size=2)
+            pipeline = PipelineConfig(pp_size=pp, schedule=schedule,
+                                      num_microbatches=k)
+        else:
+            mm = MeshManager(dp_size=4, tp_size=2)
+            pipeline = None
+        plan = build_parallel_plan(model, mm)
+        fns = build_train_step(
+            model, build_optimizer(name="adamw", lr=1e-3),
+            loss_fn=MaskedCrossEntropy(), plan=plan, pipeline=pipeline)
+        params = plan.shard_params(model.init(jax.random.key(0)))
+        opt_state = fns.init_opt_state(params)
+
+        def one_step(params, opt_state):
+            batch = fns.shard_batch(dict(stacked))
+            return fns.train_step(params, opt_state, batch)
+
+        for _ in range(warmup):
+            params, opt_state, m = one_step(params, opt_state)
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, m = one_step(params, opt_state)
+        jax.block_until_ready(m["loss"])
+        assert np.isfinite(float(m["loss"]))
+        return steps * ids.size / (time.perf_counter() - t0)
+
+    dense = run(1)
+    piped = run(2)
+    print(json.dumps({
+        "tps": round(piped, 1),
+        "vs_baseline": round(piped / dense, 4),
+        "pp_bubble_fraction": round(pp_bubble_fraction(2, k, schedule), 4),
+    }))
 
 
 def _moe_secondary_main() -> None:
@@ -772,6 +867,8 @@ def _secondary_main(name: str) -> None:
     """Child process: one secondary config, prints {"tps": ...}."""
     if name == "long_context_16k_cp":
         return _cp_secondary_main()
+    if name == "pipeline":
+        return _pipeline_secondary_main()
     if name == "moe":
         return _moe_secondary_main()
     if name == "moe_quant":

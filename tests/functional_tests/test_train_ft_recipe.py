@@ -237,4 +237,4 @@ def test_recipe_hsdp_tp_sp_packed_composition(tmp_path):
     import math
 
     assert math.isfinite(recipe.last_metrics["loss"])
-    assert recipe.mesh_manager.shape == (1, 2, 2, 1, 2)  # +dcn_dp
+    assert recipe.mesh_manager.shape == (1, 1, 2, 2, 1, 2)  # +dcn_dp, +pp

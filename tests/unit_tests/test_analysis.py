@@ -322,3 +322,73 @@ def test_dcn_leg_confines_dense_collectives_to_ici():
     # expert/token shuffles (all-to-all) must never cross slices
     for key in hlo.get("all-to-all", {}):
         assert "dcn_dp" not in key.split(",")
+
+
+def test_pp_leg_boundary_permutes_keyed_to_pp_only():
+    """The pipeline pin behind the ``pp2xdp2`` golden (ISSUE 13): at the
+    jaxpr level the ONLY explicit permutes are the 1F1B stage-boundary
+    sends (fwd) and their AD mirrors (bwd), keyed to the ``pp`` axis alone
+    — a permute on any other key would mean schedule traffic leaked off the
+    documented seam (``train_step._make_pp_shift``)."""
+    _, census = _leg_and_census("pp2xdp2")
+    perms = census.collectives.get("ppermute", {})
+    assert perms, "pipelined step lowered with no stage-boundary ppermute"
+    assert set(perms) == {"pp"}, (
+        f"stage-boundary permutes keyed off the pp seam: {perms}")
+    # and the compiled program carries them as collective-permutes over pp
+    assert census.hlo_collectives["collective-permute"].get("pp", 0) > 0
+
+
+def test_pp_leg_no_slab_scale_gather_over_pp():
+    """Nothing bigger than ONE boundary activation buffer may cross the pp
+    seam as an all-gather: a parameter/slab-sized gather over pp would mean
+    a stage pulled another stage's layers — pipelining structurally broken.
+    (XLA legitimately reshards a few boundary-activation-sized tensors over
+    pp for the embed-select path; their exact counts are pinned by the
+    golden, and this bound keeps them activation-scale forever.)"""
+    leg, census = _leg_and_census("pp2xdp2")
+    mesh_shape = dict(leg.plan.mesh.shape)
+    pp = mesh_shape["pp"]
+    # [pp, B_mb, S, H] fp32: the boundary buffer ceiling, derived from the
+    # leg's OWN batch geometry so a legs.py/model resize cannot silently
+    # loosen (or false-fail) the bound
+    from automodel_tpu.analysis.legs import flagship_tiny_model
+
+    _, _, batch = leg.abstract_args
+    _, B, S = batch["input_ids"].shape
+    k = leg.fns.pp_num_microbatches
+    H = flagship_tiny_model().config.hidden_size
+    bound = pp * (B // k) * S * H * 4
+    for key, nbytes in (census.hlo_allgather_max_bytes or {}).items():
+        if "pp" in key.split(","):
+            assert nbytes <= bound, (
+                f"all-gather over {key} moved {nbytes}B (> boundary buffer "
+                f"{bound}B): slab-scale data crossed the pp seam")
+
+
+def test_pp_leg_compiles_once_and_batch_never_shards_over_pp():
+    """The pipelined step must be one XLA program (slot/microbatch counts
+    are static), and the batch sharding spec must never name pp — every
+    stage sees the full microbatch stream."""
+    import jax
+
+    from automodel_tpu.analysis.jaxpr_audit import assert_compiles_once
+
+    leg = build_leg("pp2xdp2")
+    params, opt, batch = leg.abstract_args
+
+    def concrete(t):
+        return jax.tree.map(
+            lambda s: jax.device_put(
+                np.zeros(s.shape, s.dtype), s.sharding), t)
+
+    p, o = concrete(params), concrete(opt)
+    b = {k: jax.device_put(np.zeros(v.shape, v.dtype), v.sharding)
+         for k, v in batch.items()}
+    p, o, m = leg.fns.train_step(p, o, b)
+    p, o, m = leg.fns.train_step(p, o, b)
+    assert_compiles_once(leg.fns.train_step, "pp2xdp2 train_step")
+    spec = leg.fns.microbatch_sharding.spec
+    flat = [a for part in spec if part
+            for a in ((part,) if isinstance(part, str) else part)]
+    assert "pp" not in flat, f"batch spec names pp: {spec}"

@@ -310,3 +310,42 @@ def test_cli_fails_on_a_seeded_violation(tmp_path):
         capture_output=True, text=True, cwd=_REPO)
     assert proc.returncode == 1
     assert "L001" in proc.stdout and "bad.py:1" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# L007 — ppermute confined to ops/ + training/train_step.py
+# ---------------------------------------------------------------------------
+def test_l007_flags_ppermute_outside_its_homes():
+    src = ("from jax import lax\n"
+           "def f(x):\n"
+           "    return lax.ppermute(x, 'pp', [(0, 1)])\n")
+    hits = _lint(src, rel="automodel_tpu/training/pipeline.py",
+                 select=["L007"])
+    assert _rules(hits) == ["L007"]
+    hits = _lint("import jax\n"
+                 "def f(x):\n"
+                 "    return jax.lax.ppermute(x, 'cp', [(0, 1)])\n",
+                 rel="automodel_tpu/recipes/llm/train_ft.py",
+                 select=["L007"])
+    assert _rules(hits) == ["L007"]
+    # the import form is flagged too (an aliased call would evade the
+    # attribute-chain check otherwise)
+    hits = _lint("from jax.lax import ppermute\n",
+                 rel="automodel_tpu/serving/engine.py", select=["L007"])
+    assert _rules(hits) == ["L007"]
+
+
+def test_l007_clean_in_ops_train_step_and_with_suppression():
+    src = ("from jax import lax\n"
+           "def f(x):\n"
+           "    return lax.ppermute(x, 'cp', [(0, 1)])\n")
+    assert _lint(src, rel="automodel_tpu/ops/ring_attention.py",
+                 select=["L007"]) == []
+    assert _lint(src, rel="automodel_tpu/training/train_step.py",
+                 select=["L007"]) == []
+    suppressed = ("from jax import lax\n"
+                  "def f(x):\n"
+                  "    return lax.ppermute(x, 'pp', [(0, 1)])"
+                  "  # lint: disable=L007 (drill harness permute)\n")
+    assert _lint(suppressed, rel="automodel_tpu/analysis/elastic_drill.py",
+                 select=["L007"]) == []

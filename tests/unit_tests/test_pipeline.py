@@ -1,0 +1,510 @@
+"""Pipeline parallelism (ISSUE 13): the 1F1B/GPipe schedule over the ``pp``
+mesh axis.
+
+Tier-1 coverage of the microbatch splitter and the pipelined train step:
+
+* mesh/plan plumbing — ``pp_size > 1`` builds the pp axis below ``dcn_dp``
+  and shards the stacked-layer dim over it;
+* the microbatch splitter's non-divisible errors (splitter, config-level
+  ``global_batch_size`` contract, loader int/enum validation at load AND
+  after CLI overrides);
+* the ``k=1`` degenerate schedule is BITWISE the dense step; ``pp=1, k>1``
+  matches to float re-association;
+* ``pp=2`` loss/grad parity vs the dense step for BOTH schedules, with
+  grad accumulation (accum outside the microbatch loop) and
+  packed-sequence batches (segment_ids + true position_ids surviving the
+  split, ``num_label_tokens`` exact);
+* pp-unsafe models (seqcls last-token pooling, family-specific forwards,
+  MoE aux, PEFT masks, hidden-state losses) rejected loudly.
+
+The collective-census pins for the pipelined step live in
+``test_analysis.py`` (``pp2xdp2`` golden + structural tests).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from automodel_tpu.analysis.legs import flagship_tiny_model
+from automodel_tpu.distributed.mesh import MESH_AXES, MeshManager
+from automodel_tpu.distributed.shardings import (
+    build_parallel_plan,
+    stage_boundary_spec,
+)
+from automodel_tpu.loss.masked_ce import IGNORE_INDEX, MaskedCrossEntropy
+from automodel_tpu.optim import build_optimizer
+from automodel_tpu.training.pipeline import (
+    PipelineConfig,
+    build_pipeline_config,
+    ensure_pp_compatible,
+    schedule_slots,
+    split_microbatches,
+    validate_pipeline_batch,
+)
+from automodel_tpu.training.timers import pp_bubble_fraction
+from automodel_tpu.training.train_step import build_train_step
+
+
+def _batch(A=2, B=8, S=32, seed=0, packed=False):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 255, (A, B, S))
+    labels = np.roll(ids, -1, -1)
+    labels[..., -1] = IGNORE_INDEX
+    out = {"input_ids": ids.astype(np.int32),
+           "labels": labels.astype(np.int32)}
+    if packed:
+        # two packed segments per row with true restart positions, plus a
+        # padded tail (segment 0, labels ignored)
+        seg = np.zeros((A, B, S), np.int32)
+        pos = np.zeros((A, B, S), np.int32)
+        cut, tail = S // 2, S - 4
+        seg[..., :cut] = 1
+        seg[..., cut:tail] = 2
+        pos[..., :cut] = np.arange(cut)
+        pos[..., cut:tail] = np.arange(tail - cut)
+        labels[..., tail:] = IGNORE_INDEX
+        out["segment_ids"] = seg
+        out["position_ids"] = pos
+        out["labels"] = labels.astype(np.int32)
+    return out
+
+
+def _fns(mm, pipeline=None, seed=0, wd=0.0):
+    model = flagship_tiny_model()
+    plan = build_parallel_plan(model, mm)
+    fns = build_train_step(
+        model, build_optimizer(name="adamw", lr=1e-3, weight_decay=wd),
+        loss_fn=MaskedCrossEntropy(), plan=plan, pipeline=pipeline)
+    params = plan.shard_params(model.init(jax.random.key(seed)))
+    return model, plan, fns, params
+
+
+def _step(fns, params, stacked):
+    opt = fns.init_opt_state(params)
+    batch = fns.shard_batch(dict(stacked))
+    _, _, m = fns.train_step(params, opt, batch)
+    return (float(m["loss"]), float(m["grad_norm"]),
+            int(float(m["num_label_tokens"])))
+
+
+# ---------------------------------------------------------------------------
+# Mesh / plan plumbing
+# ---------------------------------------------------------------------------
+def test_mesh_builds_pp_axis_below_dcn_dp():
+    mm = MeshManager(pp_size=2, dp_size=2, tp_size=2)
+    assert mm.pp_size == 2 and mm.dp_size == 2 and mm.tp_size == 2
+    assert mm.mesh.shape["pp"] == 2
+    assert MESH_AXES.index("pp") == MESH_AXES.index("dcn_dp") + 1
+    # world-size arithmetic includes pp
+    with pytest.raises(ValueError, match="device count|world size"):
+        MeshManager(pp_size=3)
+    with pytest.raises(ValueError, match="pp_size"):
+        MeshManager(pp_size=0)
+
+
+def test_plan_shards_layer_stack_over_pp():
+    model = flagship_tiny_model()
+    mm = MeshManager(pp_size=2, dp_size=2, tp_size=2)
+    plan = build_parallel_plan(model, mm)
+    assert plan.pp_size == 2
+    q_spec = plan.param_specs["layers"]["self_attn"]["q_proj"]["kernel"]
+    assert q_spec[0] == "pp", q_spec
+    # non-stacked params (embedding, final norm) never name pp
+    emb_spec = plan.param_specs["embed_tokens"]["embedding"]
+    flat = [a for part in emb_spec if part
+            for a in ((part,) if isinstance(part, str) else part)]
+    assert "pp" not in flat
+    # a pp=1 mesh keeps the dense rules (layers unsharded)
+    dense_plan = build_parallel_plan(model, MeshManager(dp_size=4,
+                                                        tp_size=2))
+    assert dense_plan.param_specs["layers"]["self_attn"]["q_proj"][
+        "kernel"][0] is None or dense_plan.param_specs["layers"][
+        "self_attn"]["q_proj"]["kernel"][0] != "pp"
+
+
+def test_stage_boundary_spec_carries_pp_and_batch_axes():
+    spec = stage_boundary_spec()
+    assert spec[0] == "pp"
+    flat = [a for part in spec[1:] if part
+            for a in ((part,) if isinstance(part, str) else part)]
+    assert "dp_shard" in flat and "pp" not in flat
+
+
+# ---------------------------------------------------------------------------
+# Splitter / config errors
+# ---------------------------------------------------------------------------
+def test_split_microbatches_rejects_non_divisible_batch():
+    mb = {"input_ids": np.zeros((6, 8)), "labels": np.zeros((6, 8))}
+    with pytest.raises(ValueError, match="not divisible by "
+                                         "num_microbatches=4"):
+        split_microbatches(mb, 4)
+    out = split_microbatches(mb, 3)
+    assert out["input_ids"].shape == (3, 2, 8)
+    with pytest.raises(ValueError, match=">= 1"):
+        split_microbatches(mb, 0)
+
+
+def test_validate_pipeline_batch_spells_out_the_contract():
+    validate_pipeline_batch(16, 2, 4)
+    with pytest.raises(ValueError, match=r"16.*not divisible.*3 x 4"):
+        validate_pipeline_batch(16, 3, 4)
+
+
+def test_pipeline_config_validation_and_defaults():
+    cfg = PipelineConfig(pp_size=4)
+    assert cfg.schedule == "1f1b" and cfg.resolved_microbatches() == 4
+    assert PipelineConfig(pp_size=2, num_microbatches="none"
+                          ).resolved_microbatches() == 2
+    assert PipelineConfig(schedule="GPipe").schedule == "gpipe"
+    with pytest.raises(ValueError, match="1f1b.*gpipe"):
+        PipelineConfig(schedule="interleaved")
+    with pytest.raises(ValueError, match="num_microbatches"):
+        PipelineConfig(pp_size=2, num_microbatches=0)
+    with pytest.raises(ValueError, match="unknown pipeline keys"):
+        build_pipeline_config({"pp_size": 2, "microbatches": 4})
+
+
+def test_pipeline_enums_validate_at_config_load(tmp_path):
+    from automodel_tpu.config.arg_parser import parse_args_and_load_config
+    from automodel_tpu.config.loader import load_yaml_config
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("pipeline:\n  pp_size: 2\n  schedule: interleaved\n")
+    with pytest.raises(ValueError, match="pipeline.schedule"):
+        load_yaml_config(str(bad))
+    bad_int = tmp_path / "bad_int.yaml"
+    bad_int.write_text("pipeline:\n  pp_size: 2\n  num_microbatches: two\n")
+    with pytest.raises(ValueError, match="pipeline.num_microbatches"):
+        load_yaml_config(str(bad_int))
+
+    good = tmp_path / "good.yaml"
+    good.write_text("pipeline:\n  pp_size: 2\n  schedule: gpipe\n"
+                    "  num_microbatches: null\n")
+    cfg = load_yaml_config(str(good))
+    assert cfg.get("pipeline.schedule") == "gpipe"
+    # the PR-3/4 pattern: CLI overrides re-validate after parsing
+    with pytest.raises(ValueError, match="pipeline.schedule"):
+        parse_args_and_load_config(
+            ["--config", str(good), "--pipeline.schedule", "banana"])
+    cfg = parse_args_and_load_config(
+        ["--config", str(good), "--pipeline.schedule", "1f1b",
+         "--pipeline.num_microbatches", "null"])
+    assert cfg.get("pipeline.schedule") == "1f1b"
+    assert build_pipeline_config(
+        cfg.get("pipeline")).resolved_microbatches() == 2
+
+
+def test_build_train_step_rejects_mesh_schedule_mismatch():
+    mm = MeshManager(pp_size=2, dp_size=2, tp_size=2)
+    model = flagship_tiny_model()
+    plan = build_parallel_plan(model, mm)
+    with pytest.raises(ValueError, match="disagrees with the mesh"):
+        build_train_step(model, build_optimizer(name="adamw", lr=1e-3),
+                         loss_fn=MaskedCrossEntropy(), plan=plan,
+                         pipeline=PipelineConfig(pp_size=4))
+    with pytest.raises(ValueError, match="needs a ParallelPlan"):
+        build_train_step(model, build_optimizer(name="adamw", lr=1e-3),
+                         loss_fn=MaskedCrossEntropy(),
+                         pipeline=PipelineConfig(pp_size=2))
+
+
+# ---------------------------------------------------------------------------
+# Schedule arithmetic / bubble accounting
+# ---------------------------------------------------------------------------
+def test_schedule_slots_and_bubble_fraction():
+    assert schedule_slots(4, 8, "gpipe") == (11, 3, 1)
+    assert schedule_slots(4, 8, "1f1b") == (14, 6, 2)
+    assert schedule_slots(1, 4, "1f1b") == (4, 0, 2)
+    assert pp_bubble_fraction(1, 8) == 0.0
+    assert pp_bubble_fraction(4, 8, "gpipe") == pytest.approx(3 / 11)
+    assert pp_bubble_fraction(4, 8, "1f1b") == pytest.approx(6 / 14)
+    # more microbatches -> smaller bubble, monotonically
+    assert (pp_bubble_fraction(4, 32, "1f1b")
+            < pp_bubble_fraction(4, 8, "1f1b"))
+
+
+# ---------------------------------------------------------------------------
+# Degenerate schedules (pp=1)
+# ---------------------------------------------------------------------------
+def test_k1_degenerate_schedule_is_bitwise_the_dense_step():
+    mm = MeshManager(dp_size=4, tp_size=2)
+    stacked = _batch()
+    _, _, dense, params = _fns(mm)
+    loss_d, gn_d, n_d = _step(dense, params, stacked)
+    _, _, piped, params2 = _fns(mm, PipelineConfig(num_microbatches=1))
+    loss_p, gn_p, n_p = _step(piped, params2, stacked)
+    assert (loss_p, gn_p, n_p) == (loss_d, gn_d, n_d)  # BITWISE
+    assert piped.pp_size == 1 and piped.pp_num_microbatches == 1
+
+
+def test_pp1_k2_split_matches_dense_to_reassociation():
+    mm = MeshManager(dp_size=4, tp_size=2)
+    stacked = _batch()
+    _, _, dense, params = _fns(mm)
+    loss_d, gn_d, n_d = _step(dense, params, stacked)
+    _, _, piped, params2 = _fns(mm, PipelineConfig(num_microbatches=2))
+    loss_p, gn_p, n_p = _step(piped, params2, stacked)
+    assert n_p == n_d
+    assert abs(loss_p - loss_d) < 1e-3 and abs(gn_p - gn_d) < 1e-3
+
+
+# ---------------------------------------------------------------------------
+# pp=2 parity vs dense (the tentpole invariant)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+def test_pp2_loss_grad_parity_with_grad_accum(schedule):
+    """pp=2 x dp=2 x tp=2 vs dense dp=4 x tp=2, same init/batch, A=2 grad
+    accumulation: the pipelined step must reproduce the dense loss,
+    grad_norm and token count (accum scan wraps the pipeline — 'accum
+    outside the microbatch loop')."""
+    stacked = _batch(A=2)
+    _, _, dense, params = _fns(MeshManager(dp_size=4, tp_size=2), wd=0.01)
+    loss_d, gn_d, n_d = _step(dense, params, stacked)
+    mm = MeshManager(pp_size=2, dp_size=2, tp_size=2)
+    _, _, piped, params2 = _fns(
+        mm, PipelineConfig(pp_size=2, schedule=schedule,
+                           num_microbatches=2), wd=0.01)
+    loss_p, gn_p, n_p = _step(piped, params2, stacked)
+    assert n_p == n_d
+    assert abs(loss_p - loss_d) < 1e-3, (loss_p, loss_d)
+    assert abs(gn_p - gn_d) < 1e-3, (gn_p, gn_d)
+    assert piped.pp_size == 2 and piped.pp_schedule == schedule
+
+
+def test_pp2_packed_sequence_metrics_survive_the_split():
+    """Packed batches (segment_ids + true position_ids) through the
+    pipelined step: the split must carry the per-token aux arrays with
+    their rows, the masked-token count must be EXACT (padded tails
+    excluded), and the loss must match the dense step."""
+    stacked = _batch(A=1, B=8, S=32, packed=True)
+    _, _, dense, params = _fns(MeshManager(dp_size=4, tp_size=2))
+    loss_d, gn_d, n_d = _step(dense, params, stacked)
+    expected_tokens = int(np.sum(stacked["labels"] != IGNORE_INDEX))
+    assert n_d == expected_tokens
+    mm = MeshManager(pp_size=2, dp_size=2, tp_size=2)
+    _, _, piped, params2 = _fns(
+        mm, PipelineConfig(pp_size=2, num_microbatches=4))
+    loss_p, gn_p, n_p = _step(piped, params2, stacked)
+    assert n_p == expected_tokens
+    assert abs(loss_p - loss_d) < 1e-3 and abs(gn_p - gn_d) < 1e-3
+
+
+def test_pp2_eval_step_matches_dense_eval():
+    stacked = _batch(A=1)
+    _, _, dense, params = _fns(MeshManager(dp_size=4, tp_size=2))
+    batch_d = dense.shard_batch(dict(stacked))
+    md = dense.eval_step(params, batch_d)
+    mm = MeshManager(pp_size=2, dp_size=2, tp_size=2)
+    _, _, piped, params2 = _fns(mm, PipelineConfig(pp_size=2,
+                                                   num_microbatches=2))
+    batch_p = piped.shard_batch(dict(stacked))
+    mp = piped.eval_step(params2, batch_p)
+    assert abs(float(mp["loss"]) - float(md["loss"])) < 1e-3
+
+
+# ---------------------------------------------------------------------------
+# pp-unsafe configurations reject loudly
+# ---------------------------------------------------------------------------
+def test_seqcls_last_token_pooling_rejects_pp():
+    from automodel_tpu.models.sequence_classification import (
+        ForSequenceClassification,
+    )
+
+    model = ForSequenceClassification(flagship_tiny_model(), num_labels=3)
+    assert model.pp_safe is False
+    with pytest.raises(ValueError, match="not pp-safe"):
+        ensure_pp_compatible(model)
+    mm = MeshManager(pp_size=2, dp_size=2, tp_size=2)
+    plan = build_parallel_plan(flagship_tiny_model(), mm)
+    with pytest.raises(ValueError, match="ForSequenceClassification"):
+        build_train_step(model, build_optimizer(name="adamw", lr=1e-3),
+                         loss_fn=MaskedCrossEntropy(), plan=plan,
+                         pipeline=PipelineConfig(pp_size=2))
+
+
+def test_family_specific_forwards_and_masks_reject_pp():
+    from automodel_tpu.models.deepseek_v3 import (
+        DeepseekV3Config,
+        DeepseekV3ForCausalLM,
+    )
+
+    mla = DeepseekV3ForCausalLM(DeepseekV3Config(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        q_lora_rank=8, kv_lora_rank=8, qk_rope_head_dim=4,
+        qk_nope_head_dim=4, v_head_dim=8, n_routed_experts=2,
+        num_experts_per_tok=1, n_shared_experts=1, moe_intermediate_size=16,
+        first_k_dense_replace=1))
+    with pytest.raises(ValueError, match="forward_embeds|not pp-safe"):
+        ensure_pp_compatible(mla)
+
+    model = flagship_tiny_model()
+    with pytest.raises(ValueError, match="hidden-state losses"):
+        from automodel_tpu.loss.linear_ce import FusedLinearCrossEntropy
+
+        ensure_pp_compatible(model, FusedLinearCrossEntropy(chunk_len=16))
+    with pytest.raises(ValueError, match="PEFT"):
+        ensure_pp_compatible(model, MaskedCrossEntropy(),
+                             trainable_mask={"fake": True})
+
+
+def test_moe_aux_rejected_at_trace_time():
+    from automodel_tpu.analysis.legs import moe_tiny_model
+
+    moe = moe_tiny_model(tp=2)
+    mm = MeshManager(pp_size=2, dp_size=2, tp_size=2)
+    # Mixtral inherits the stock forward (pp_safe True), so the gate passes
+    # and the per-layer aux loss must be caught when the stage traces
+    plan = build_parallel_plan(moe, mm)
+    fns = build_train_step(moe, build_optimizer(name="adamw", lr=1e-3),
+                           loss_fn=MaskedCrossEntropy(), plan=plan,
+                           pipeline=PipelineConfig(pp_size=2))
+    stacked = _batch(A=1)
+    params = plan.shard_params(moe.init(jax.random.key(0)))
+    opt = fns.init_opt_state(params)
+    batch = fns.shard_batch(dict(stacked))
+    with pytest.raises(NotImplementedError, match="aux loss"):
+        fns.train_step(params, opt, batch)
+
+
+def test_pipeline_rejects_unconsumed_batch_keys():
+    mm = MeshManager(pp_size=2, dp_size=2, tp_size=2)
+    _, plan, fns, params = _fns(mm, PipelineConfig(pp_size=2,
+                                                   num_microbatches=2))
+    stacked = _batch(A=1)
+    stacked["pixel_values"] = np.zeros((1, 8, 1, 4, 4, 3), np.float32)
+    opt = fns.init_opt_state(params)
+    batch = fns.shard_batch(dict(stacked))
+    with pytest.raises(ValueError, match="pixel_values"):
+        fns.train_step(params, opt, batch)
+
+
+# ---------------------------------------------------------------------------
+# Review-hardening regressions
+# ---------------------------------------------------------------------------
+def test_pipeline_config_rejects_pp_size_zero():
+    # 0 must reach the >= 1 guard (an `or 1` coercion once ate it silently)
+    with pytest.raises(ValueError, match="pp_size"):
+        PipelineConfig(pp_size=0)
+
+
+def test_distributed_pp_size_keeps_explicit_schedule_knobs(tmp_path):
+    """Sizing the pp axis via distributed.pp_size must NOT discard an
+    explicit schedule/num_microbatches from the pipeline: block — the
+    recipe adopts the mesh's pp into the existing config."""
+    from automodel_tpu.config.arg_parser import parse_args_and_load_config
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction as R,
+    )
+
+    recipe = R(parse_args_and_load_config([
+        "--config", "examples/llm_finetune/tiny_llama_mock.yaml",
+        "--checkpoint.enabled", "false",
+        "--distributed.pp_size", "2",
+        "--pipeline.schedule", "gpipe",
+        "--pipeline.num_microbatches", "2",
+        "--step_scheduler.local_batch_size", "2",
+        "--step_scheduler.global_batch_size", "16",
+        "--step_scheduler.max_steps", "1"]))
+    recipe.setup()
+    assert recipe.pipeline_config.pp_size == 2
+    assert recipe.pipeline_config.schedule == "gpipe"
+    assert recipe.pipeline_config.num_microbatches == 2
+    assert recipe.step_fns.pp_schedule == "gpipe"
+
+
+def test_degenerate_split_carries_dropout_rng_whole():
+    """dropout_rng is per-grad-accum-microbatch KEY data, not batch rows:
+    the pp=1 k>1 split must fold per-sub-microbatch keys instead of
+    reshaping the (2,) key data (which crashed wrap_key_data)."""
+    mm = MeshManager(dp_size=4, tp_size=2)
+    _, _, piped, params = _fns(mm, PipelineConfig(num_microbatches=2))
+    stacked = _batch(A=2)
+    stacked["dropout_rng"] = np.stack([
+        np.asarray(jax.random.key_data(k))
+        for k in jax.random.split(jax.random.key(7), 2)])
+    loss, gn, n = _step(piped, params, stacked)
+    assert np.isfinite(loss) and np.isfinite(gn)
+
+
+def test_build_train_step_adopts_mesh_pp_into_schedule_only_config():
+    """A PipelineConfig that only picks schedule knobs (pp_size left 1) on
+    a pp>1 mesh must adopt the mesh's stage count — num_microbatches then
+    resolves against the REAL pp instead of silently running k=1."""
+    mm = MeshManager(pp_size=2, dp_size=2, tp_size=2)
+    model = flagship_tiny_model()
+    plan = build_parallel_plan(model, mm)
+    fns = build_train_step(model, build_optimizer(name="adamw", lr=1e-3),
+                           loss_fn=MaskedCrossEntropy(), plan=plan,
+                           pipeline=PipelineConfig(schedule="gpipe"))
+    assert fns.pp_size == 2 and fns.pp_schedule == "gpipe"
+    assert fns.pp_num_microbatches == 2
+
+
+def test_degenerate_split_divisibility_validated_at_setup():
+    """pp=1 with a pipeline block must enforce local_batch_size % k at
+    SETUP (the advertised contract), not at first trace."""
+    from automodel_tpu.config.arg_parser import parse_args_and_load_config
+    from automodel_tpu.recipes.llm.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction as R,
+    )
+
+    with pytest.raises(ValueError, match="local_batch_size=1 is not "
+                                         "divisible"):
+        R(parse_args_and_load_config([
+            "--config", "examples/llm_finetune/tiny_llama_mock.yaml",
+            "--checkpoint.enabled", "false",
+            "--pipeline.num_microbatches", "3"])).setup()
+
+
+def test_degenerate_split_rejects_non_row_keys():
+    """pp=1, k>1 must apply the same key gate as pp>1: keys whose leading
+    dim is NOT batch rows (VLM pixel_values lead with image counts) cannot
+    ride the row split — silently re-pairing images with the wrong text
+    is exactly the failure the gate exists for."""
+    mm = MeshManager(dp_size=4, tp_size=2)
+    _, _, piped, params = _fns(mm, PipelineConfig(num_microbatches=2))
+    stacked = _batch(A=1)
+    stacked["pixel_values"] = np.zeros((1, 8, 1, 4, 4, 3), np.float32)
+    opt = piped.init_opt_state(params)
+    batch = piped.shard_batch(dict(stacked))
+    with pytest.raises(ValueError, match="pixel_values"):
+        piped.train_step(params, opt, batch)
+
+
+def test_pp_honors_scan_block_remat_grouping():
+    """model.scan_block must survive the stage split (block remat grouping
+    per stage, same numerics) and a non-dividing block must fail loudly."""
+    import jax.numpy as jnp
+
+    from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    def make(scan_block):
+        return LlamaForCausalLM(
+            LlamaConfig(vocab_size=256, hidden_size=32,
+                        intermediate_size=64, num_hidden_layers=4,
+                        num_attention_heads=4, num_key_value_heads=2,
+                        rope_theta=10000.0, tie_word_embeddings=True),
+            param_dtype=jnp.bfloat16, compute_dtype=jnp.bfloat16,
+            scan_block=scan_block)
+
+    stacked = _batch(A=1, B=8, S=16)
+
+    def run(model, mm, pipeline):
+        plan = build_parallel_plan(model, mm)
+        fns = build_train_step(
+            model, build_optimizer(name="adamw", lr=1e-3),
+            loss_fn=MaskedCrossEntropy(), plan=plan, pipeline=pipeline)
+        params = plan.shard_params(model.init(jax.random.key(0)))
+        return _step(fns, params, stacked)
+
+    dense = run(make(2), MeshManager(dp_size=4, tp_size=2), None)
+    piped = run(make(2), MeshManager(pp_size=2, dp_size=2, tp_size=2),
+                PipelineConfig(pp_size=2, num_microbatches=2))
+    assert abs(piped[0] - dense[0]) < 1e-3
+    assert abs(piped[1] - dense[1]) < 1e-3
+    # L/pp = 2 with scan_block=4: not divisible per stage -> loud error
+    with pytest.raises(ValueError, match="scan_block=4 must divide"):
+        run(make(4), MeshManager(pp_size=2, dp_size=2, tp_size=2),
+            PipelineConfig(pp_size=2, num_microbatches=2))
