@@ -364,7 +364,10 @@ def _enum_fields():
     from automodel_tpu.ops.quant import QUANT_DTYPES, QUANT_RECIPES
     from automodel_tpu.ops.zigzag import CP_LAYOUTS
     from automodel_tpu.serving.kv_cache import KV_CACHE_DTYPES
-    from automodel_tpu.serving.scheduler import SCHEDULER_POLICIES
+    from automodel_tpu.serving.scheduler import (
+        SCHEDULER_POLICIES,
+        SHED_POLICIES,
+    )
     from automodel_tpu.training.pipeline import PP_SCHEDULES
 
     return {
@@ -375,6 +378,7 @@ def _enum_fields():
         "fp8.recipe_name": QUANT_RECIPES,
         "serving.kv_cache_dtype": KV_CACHE_DTYPES,
         "serving.scheduler_policy": SCHEDULER_POLICIES,
+        "serving.shed_policy": SHED_POLICIES,
         "pipeline.schedule": PP_SCHEDULES,
     }
 
@@ -401,7 +405,13 @@ _BOOL_FIELDS = ("checkpoint.async_save", "checkpoint.replicate_to_peers")
 # null`` resolves to pp_size); anything else must be an integer >= 1 — a
 # typo'd microbatch count must fail at load, not as a reshape error deep in
 # the pipelined step's trace.
-_POSITIVE_INT_FIELDS = ("pipeline.pp_size", "pipeline.num_microbatches")
+_POSITIVE_INT_FIELDS = ("pipeline.pp_size", "pipeline.num_microbatches",
+                        "serving.max_waiting", "serving.max_preemptions",
+                        "serving.sjf_aging_steps")
+
+# Positive-number (int or float) fields: wall-clock windows where 0/negative
+# is always a typo ("null" disables the feature instead).
+_POSITIVE_NUM_FIELDS = ("serving.watchdog_s", "serving.drain_grace_s")
 
 
 def normalize_null_spelling(v: Any) -> Any:
@@ -452,6 +462,17 @@ def validate_config_enums(cfg: "ConfigNode") -> None:
             raise ValueError(
                 f"config field {dotted!r} must be an integer >= 1 (or null "
                 f"for the default), got {v!r}")
+    for dotted in _POSITIVE_NUM_FIELDS:
+        v = cfg.get(dotted, _UNSET)
+        if v is _UNSET:
+            continue
+        v = normalize_null_spelling(v)
+        if v is None:
+            continue
+        if isinstance(v, bool) or not isinstance(v, (int, float)) or v <= 0:
+            raise ValueError(
+                f"config field {dotted!r} must be a positive number (or "
+                f"null to disable), got {v!r}")
 
 
 def load_yaml_config(path: str) -> ConfigNode:

@@ -5,8 +5,10 @@ Layout::
 
     serving/
       kv_cache.py   block pools + allocator + the PagedKVView pytree
-      scheduler.py  per-request state machine, chunked prefill, preemption
-      engine.py     static-shape jitted steps + the host decode loop
+      scheduler.py  per-request state machine, chunked prefill, preemption,
+                    deadlines/TTLs, admission control, the pin breaker
+      engine.py     static-shape jitted steps + the host decode loop,
+                    watchdog recovery + graceful drain
       eval.py       online-eval consumer (greedy scoring via the engine)
 
 The paged attention kernels live on the PR-7 substrate in
@@ -26,7 +28,9 @@ from automodel_tpu.serving.kv_cache import (        # noqa: F401
 )
 from automodel_tpu.serving.scheduler import (       # noqa: F401
     SCHEDULER_POLICIES,
+    SHED_POLICIES,
     Request,
+    RequestRejected,
     RequestState,
     Scheduler,
 )

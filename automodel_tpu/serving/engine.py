@@ -10,9 +10,10 @@ that with three static-shape ingredients:
 * **step buffers** — every device step is ``[max_num_seqs, W]`` where the
   width ``W`` is 1 (pure decode) or ``prefill_chunk`` (a step carrying any
   prefill work; decode rows ride along with one valid token).  One jitted
-  program per width, compiled once — admissions, finishes, preemptions and
-  aborts only change the *contents* of the buffers (the tier-1 suite holds
-  ``assert_compiles_once`` across a multi-request run);
+  program per width, compiled once — admissions, finishes, preemptions,
+  aborts, expiries and rejections only change the *contents* of the
+  buffers (the tier-1 suite holds ``assert_compiles_once`` across a
+  multi-request run);
 * **the paged KV cache** (``serving/kv_cache.py``) — pools donated through
   the step so cache updates are in-place, block tables assembled host-side
   from the scheduler's plan;
@@ -21,6 +22,25 @@ that with three static-shape ingredients:
   decode, in-flight admission when blocks free up, and recompute
   preemption under KV pressure (drilled by the ``serve_block_alloc`` fault
   point; mid-flight cancels by ``serve_request_abort``).
+
+The request-lifecycle robustness layer rides entirely HOST-SIDE on top of
+those three (the decode step's census stays collective- and
+callback-free): per-request deadlines/TTLs and admission control live in
+the scheduler (``serving/scheduler.py`` docstring), and the engine adds
+
+* **a watchdog** (``serving.watchdog_s``) — when no slot makes progress
+  within the window (a wedged scheduler/host loop; drilled as a stalled
+  device step by the ``serve_watchdog_stall`` fault point), the engine
+  aborts the in-flight batch, REBUILDS the pools (donated buffers cannot
+  be trusted after a failed step), reclaims every block table, and
+  replays the admitted requests from their last computed token — pinned,
+  so recovery never stacks preemptions on the stall it just absorbed.
+  Greedy output through a recovery stays token-identical (recompute
+  semantics, tier-1 pinned);
+* **graceful drain** (:meth:`DecodeEngine.drain`) — stop admitting,
+  finish in-flight work, bounded by a grace deadline (then remaining
+  rows EXPIRE with blocks reclaimed).  ``tools/serve.py`` wires it to
+  SIGTERM/SIGINT mirroring the trainer's preemption grace window.
 
 Greedy sampling runs on-device inside the step (one ``[B]`` token fetch
 per step is the engine's only host sync); ``do_sample`` configs sample
@@ -34,7 +54,9 @@ from __future__ import annotations
 import dataclasses
 import functools
 import itertools
-from typing import Any, Dict, List, Optional
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -54,14 +76,25 @@ from automodel_tpu.serving.kv_cache import (
 )
 from automodel_tpu.serving.scheduler import (
     DEFAULT_SCHEDULER_POLICY,
+    DEFAULT_SHED_POLICY,
+    DEFAULT_SJF_AGING_STEPS,
     Request,
+    RequestRejected,
     RequestState,
     Scheduler,
     StepPlan,
     normalize_scheduler_policy,
+    normalize_shed_policy,
     validate_scheduler_policy,
+    validate_shed_policy,
 )
 from automodel_tpu.utils.fault_injection import InjectedFault, fault_point
+
+logger = logging.getLogger(__name__)
+
+# drain(grace_s=...) default sentinel: "use serving.drain_grace_s" — an
+# explicit None means "unbounded", so None cannot double as the default
+_GRACE_FROM_CONFIG = object()
 
 
 @dataclasses.dataclass
@@ -77,6 +110,13 @@ class ServingConfig:
     num_kv_blocks: Optional[int] = None      # None -> full residency + null
     prefill_chunk: int = 32
     scheduler_policy: Optional[str] = None   # None -> fcfs
+    # -- robustness layer (docs/guides/serving.md "Production hardening") --
+    max_waiting: Optional[int] = None        # None -> unbounded queue
+    shed_policy: Optional[str] = None        # None -> reject_newest
+    max_preemptions: Optional[int] = None    # None -> never pin
+    sjf_aging_steps: Optional[int] = None    # None -> default (32)
+    watchdog_s: Optional[float] = None       # None -> watchdog disabled
+    drain_grace_s: Optional[float] = None    # None -> unbounded drain
 
     def __post_init__(self):
         for field in ("kv_block_size", "max_num_seqs", "max_model_len",
@@ -89,10 +129,33 @@ class ServingConfig:
             raise ValueError(
                 "serving.num_kv_blocks must be >= 2 (1 null + 1 usable), "
                 f"got {self.num_kv_blocks!r}")
+        from automodel_tpu.config.loader import normalize_null_spelling
+
+        for field in ("max_waiting", "max_preemptions", "sjf_aging_steps"):
+            v = normalize_null_spelling(getattr(self, field))
+            setattr(self, field, v)
+            if v is None:
+                continue
+            if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+                raise ValueError(
+                    f"serving.{field} must be an integer >= 1 (or null "
+                    f"for the default), got {v!r}")
+        for field in ("watchdog_s", "drain_grace_s"):
+            v = normalize_null_spelling(getattr(self, field))
+            setattr(self, field, v)
+            if v is None:
+                continue
+            if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                    or v <= 0:
+                raise ValueError(
+                    f"serving.{field} must be a positive number (or null "
+                    f"to disable), got {v!r}")
         self.kv_cache_dtype = validate_kv_cache_dtype(
             normalize_kv_cache_dtype(self.kv_cache_dtype))
         self.scheduler_policy = validate_scheduler_policy(
             normalize_scheduler_policy(self.scheduler_policy))
+        self.shed_policy = validate_shed_policy(
+            normalize_shed_policy(self.shed_policy))
 
     @property
     def blocks_per_seq(self) -> int:
@@ -145,23 +208,28 @@ class DecodeEngine:
     """Continuous-batching paged-KV decode over one model + params."""
 
     def __init__(self, model, params, config: Optional[ServingConfig] = None,
-                 generation: Optional[GenerationConfig] = None):
+                 generation: Optional[GenerationConfig] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 timers=None):
         self.model = model
         self.params = params
         self.config = config or ServingConfig()
         self.generation = generation or GenerationConfig()
+        self.clock = clock
+        self.timers = timers           # optional training.timers.Timers
         mcfg = model.config
         dtype = self.config.kv_cache_dtype or DEFAULT_KV_CACHE_DTYPE
         self.quantized = dtype == "int8"
         cache_dtype = jnp.int8 if self.quantized else model.compute_dtype
         num_blocks = self.config.resolved_num_blocks()
         self.max_blocks_per_seq = self.config.blocks_per_seq
-        self.pools = init_paged_pools(
+        self._pool_spec = dict(
             num_layers=mcfg.num_hidden_layers,
             num_kv_heads=mcfg.num_key_value_heads,
             head_dim=mcfg.head_dim, num_blocks=num_blocks,
             block_size=self.config.kv_block_size, cache_dtype=cache_dtype,
             quantized=self.quantized)
+        self.pools = init_paged_pools(**self._pool_spec)
         self.allocator = BlockAllocator(num_blocks)
         self.scheduler = Scheduler(
             self.allocator, max_num_seqs=self.config.max_num_seqs,
@@ -169,8 +237,15 @@ class DecodeEngine:
             block_size=self.config.kv_block_size,
             max_model_len=self.config.max_model_len,
             policy=self.config.scheduler_policy
-            or DEFAULT_SCHEDULER_POLICY)
+            or DEFAULT_SCHEDULER_POLICY,
+            max_waiting=self.config.max_waiting,
+            shed_policy=self.config.shed_policy or DEFAULT_SHED_POLICY,
+            max_preemptions=self.config.max_preemptions,
+            sjf_aging_steps=self.config.sjf_aging_steps
+            or DEFAULT_SJF_AGING_STEPS,
+            clock=clock)
         self.requests: Dict[int, Request] = {}
+        self.rejections: List[RequestRejected] = []
         self._rids = itertools.count()
         self._steps: Dict[int, Any] = {}       # width -> jitted step
         self._sample_key = jax.random.key(0)
@@ -179,6 +254,10 @@ class DecodeEngine:
         self.mixed_steps = 0
         self.aborts = 0
         self.tokens_generated = 0
+        self.watchdog_recoveries = 0
+        # clock stamp of the FIRST of the current run of no-progress steps
+        # (None while the engine is productive or idle)
+        self._no_progress_since: Optional[float] = None
 
     # -- compiled step per width (the "compiles once per bucket" seam) -----
     def step_fn(self, width: int):
@@ -193,9 +272,18 @@ class DecodeEngine:
 
     # -- request intake ----------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
-               eos_token_id: Optional[int] = "default") -> int:
+               eos_token_id: Optional[int] = "default",
+               deadline_s: Optional[float] = None,
+               max_queue_s: Optional[float] = None) -> int:
         """Queue one request; returns its id.  ``eos_token_id`` defaults to
-        the engine's :class:`GenerationConfig` (pass None to disable)."""
+        the engine's :class:`GenerationConfig` (pass None to disable).
+
+        ``deadline_s`` is an end-to-end wall budget from this call;
+        ``max_queue_s`` bounds WAITING time (both None -> unbounded).  A
+        request admission control drops is NOT an exception: its state is
+        ``REJECTED`` and the typed :class:`RequestRejected` outcome is
+        appended to ``self.rejections`` — check ``engine.requests[rid]``
+        or the return of :meth:`outcome_counts`."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("cannot serve an empty prompt")
@@ -206,11 +294,13 @@ class DecodeEngine:
             rid=rid, prompt=prompt,
             max_new_tokens=(self.generation.max_new_tokens
                             if max_new_tokens is None else max_new_tokens),
-            eos_token_id=eos_token_id)
+            eos_token_id=eos_token_id,
+            deadline_s=deadline_s, max_queue_s=max_queue_s)
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        self.scheduler.add(req)
+        rejected = self.scheduler.add(req)   # ValueError = caller bug only
         self.requests[rid] = req
+        self.rejections.extend(rejected)
         return rid
 
     def abort(self, rid: int) -> None:
@@ -258,9 +348,52 @@ class DecodeEngine:
         return int(np.asarray(sample_logits(
             jnp.asarray(last_logits[row])[None], self.generation, key))[0])
 
+    # -- the watchdog (host-side, never a trace event) ---------------------
+    def _watchdog_due(self, now: float) -> bool:
+        """True when CONSECUTIVE no-progress steps have spanned more than
+        ``watchdog_s``.  The marker only starts at a step() that produced
+        nothing while work was pending — a healthy engine whose caller
+        merely pauses between steps never trips it (every productive step
+        clears the marker)."""
+        w = self.config.watchdog_s
+        return (w is not None and self._no_progress_since is not None
+                and self.scheduler.has_work()
+                and now - self._no_progress_since > w)
+
+    def _watchdog_recover(self, reason: str) -> None:
+        """Abort the in-flight batch and replay every admitted request.
+
+        Donated pool buffers cannot be trusted after a failed/abandoned
+        step, so the pools are REBUILT (same shapes/dtypes — the compiled
+        step entries stay valid); every active request's block table is
+        reclaimed and the request parks back to WAITING, pinned, with
+        ``num_computed`` reset — the recompute replay regenerates prompt +
+        tokens-so-far, so greedy output stays token-identical."""
+        logger.warning(
+            "serving watchdog: %s — aborting the in-flight batch and "
+            "replaying %d admitted request(s) from their last computed "
+            "token", reason, len(self.scheduler.active))
+        t0 = time.perf_counter()
+        for req in list(self.scheduler.active):
+            self.scheduler.requeue_for_replay(req)
+        # every table is back on the free list; zero pools replace the
+        # untrusted donated buffers (cheap relative to the stall absorbed)
+        self.pools = init_paged_pools(**self._pool_spec)
+        self.watchdog_recoveries += 1
+        self._no_progress_since = None
+        if self.timers is not None:
+            self.timers("serve_recovery").add(time.perf_counter() - t0)
+
     def step(self) -> List[Request]:
         """One scheduler + device step; returns the requests that finished
-        on it.  No-op (empty list) when idle."""
+        on it.  No-op (empty list) when idle.  Never raises for load or
+        stall reasons: exhaustion preempts, deadlines expire, a full queue
+        sheds, and a detected wedge recovers — the engine loop under fire
+        keeps stepping.  A REAL runtime failure out of the device step
+        (not the drilled fault) still propagates — but only after the same
+        recovery ran, so the engine's state (tables reclaimed, pools
+        rebuilt) stays consistent and a caller that catches it may keep
+        stepping."""
         # The drilled mid-decode cancel: an armed ``serve_request_abort``
         # models a client disconnect — the oldest active request is aborted
         # and its block table freed before the step runs.
@@ -270,15 +403,43 @@ class DecodeEngine:
             active = self.scheduler.active
             if active:
                 self.abort(min(active, key=lambda r: r.arrival).rid)
-        plan = self.scheduler.schedule()
+        t0 = self.clock()
+        if self._watchdog_due(t0):
+            self._watchdog_recover(
+                f"no slot progress across consecutive steps spanning > "
+                f"serving.watchdog_s={self.config.watchdog_s}")
+        plan = self.scheduler.schedule(now=t0)
         if plan is None:
+            if self.scheduler.has_work():
+                # work pending but nothing schedulable: the no-progress
+                # window starts (or continues) here
+                if self._no_progress_since is None:
+                    self._no_progress_since = t0
+            else:
+                self._no_progress_since = None       # idle is not a wedge
             return []
         ids, pos, slots, tables, ctx, last = self._assemble(plan)
-        greedy, last_logits, self.pools = self.step_fn(plan.step_width)(
-            self.params, self.pools, ids, pos, slots, tables, ctx, last)
-        # the engine's one host sync: the [B] sampled tokens drive the
-        # host-side request state machine
-        greedy = np.asarray(jax.device_get(greedy))  # lint: disable=L004 (continuous batching IS a per-step host decision loop: one [B]-int fetch per step, the logits stay on device unless do_sample)
+        try:
+            # The drilled wedged-step site: an armed ``serve_watchdog_stall``
+            # stands in for a device step that never completed (the runtime
+            # surfacing a timeout/cancellation) — the watchdog recovery
+            # path must absorb it without crashing the engine loop.
+            fault_point("serve_watchdog_stall")
+            greedy, last_logits, self.pools = self.step_fn(plan.step_width)(
+                self.params, self.pools, ids, pos, slots, tables, ctx, last)
+            # the engine's one host sync: the [B] sampled tokens drive the
+            # host-side request state machine
+            greedy = np.asarray(jax.device_get(greedy))  # lint: disable=L004 (continuous batching IS a per-step host decision loop: one [B]-int fetch per step, the logits stay on device unless do_sample)
+        except InjectedFault:
+            self._watchdog_recover("injected stall (serve_watchdog_stall)")
+            return []
+        except Exception as e:
+            # a genuine runtime failure mid-dispatch: the donated pools
+            # cannot be trusted — recover FIRST (tables reclaimed, pools
+            # rebuilt, requests replay), then let the error surface so a
+            # real bug stays loud
+            self._watchdog_recover(f"device step failed: {e!r}")
+            raise
         sampled = {w.req.slot: self._sample(w.req.slot, greedy, last_logits)
                    for w in plan.active if w.samples_next}
         self.steps_run += 1
@@ -288,12 +449,18 @@ class DecodeEngine:
             self.mixed_steps += 1
         done = self.scheduler.finish_step(plan, sampled)
         self.tokens_generated += len(sampled)
+        now = self.clock()
+        self.scheduler.note_step_time(now - t0)
+        self._no_progress_since = None               # this step progressed
+        if self.timers is not None:
+            self.timers("serve_step").add(now - t0)
         return done
 
     def run(self, max_steps: Optional[int] = None) -> Dict[int, List[int]]:
-        """Drive until every submitted request finishes; returns rid ->
-        generated tokens.  ``max_steps`` (default: a generous work bound)
-        turns a scheduler bug into a loud error instead of a hang."""
+        """Drive until every submitted request reaches a terminal state;
+        returns rid -> generated tokens.  ``max_steps`` (default: a
+        generous work bound) turns a scheduler bug into a loud error
+        instead of a hang."""
         if max_steps is None:
             budget = sum(
                 blocks_needed(len(r.prompt), self.config.prefill_chunk)
@@ -309,6 +476,41 @@ class DecodeEngine:
                     f"engine made no progress within {max_steps} steps — "
                     "scheduler stall (file a bug with the request trace)")
         return {rid: list(r.out_tokens) for rid, r in self.requests.items()}
+
+    # -- graceful drain (SIGTERM/SIGINT path in tools/serve.py) ------------
+    def drain(self, grace_s=_GRACE_FROM_CONFIG) -> Dict[str, int]:
+        """Stop admitting and finish in-flight work, bounded by a grace
+        deadline.
+
+        New submissions reject immediately (typed, reason ``draining``);
+        NEVER-ADMITTED rows still waiting when the drain starts reject
+        too — a restarting client should resubmit elsewhere.  ADMITTED
+        requests keep stepping — including preempted/watchdog-replayed
+        rows parked in the waiting list: they are in-flight work and
+        re-admit with their generated tokens intact — until done or until
+        ``grace_s`` runs out, at which point the stragglers EXPIRE with
+        their blocks reclaimed.  Returns the per-terminal-state counts
+        (:meth:`outcome_counts`)."""
+        if grace_s is _GRACE_FROM_CONFIG:
+            grace_s = self.config.drain_grace_s
+        self.scheduler.draining = True
+        for req in list(self.scheduler.waiting):
+            if req.was_admitted:
+                continue     # parked in-flight work re-admits and finishes
+            self.rejections.append(
+                self.scheduler._reject(req, "draining"))
+        t0 = self.clock()
+        deadline = None if grace_s is None else t0 + grace_s
+        while self.scheduler.has_work():
+            if deadline is not None and self.clock() >= deadline:
+                for req in (list(self.scheduler.active)
+                            + list(self.scheduler.waiting)):
+                    self.scheduler.expire(req, reason="drain_deadline")
+                break
+            self.step()
+        if self.timers is not None:
+            self.timers("serve_drain").add(self.clock() - t0)
+        return self.outcome_counts()
 
     # -- the generate()-shaped oracle entry --------------------------------
     def generate(self, input_ids, prompt_lens=None,
@@ -327,6 +529,19 @@ class DecodeEngine:
                             eos_token_id=cfg.eos_token_id)
                 for b in range(B)]
         self.run()
+        # the ORACLE contract: every row must have genuinely finished — a
+        # row the robustness layer rejected/expired (e.g. a max_waiting
+        # bound on an eval engine) padded silently would corrupt scores
+        not_finished = {rid: self.requests[rid].state.value
+                        for rid in rids
+                        if self.requests[rid].state
+                        is not RequestState.FINISHED}
+        if not_finished:
+            raise RuntimeError(
+                f"engine.generate(): {len(not_finished)} of {B} rows did "
+                f"not finish ({not_finished}) — generate() is the parity "
+                "oracle and cannot pad shed/expired rows; drive lossy "
+                "traffic through submit()/step() and read outcome_counts()")
         out = np.full((B, cfg.max_new_tokens), cfg.pad_token_id, np.int32)
         for b, rid in enumerate(rids):
             toks = self.requests[rid].out_tokens
@@ -334,6 +549,30 @@ class DecodeEngine:
         return out
 
     # -- telemetry ---------------------------------------------------------
+    def outcome_counts(self) -> Dict[str, int]:
+        """Requests per lifecycle state (terminal AND in-flight) — the
+        per-terminal-state summary ``tools/serve.py`` prints and exits
+        nonzero on when anything is not ``finished``."""
+        counts: Dict[str, int] = {}
+        for req in self.requests.values():
+            counts[req.state.value] = counts.get(req.state.value, 0) + 1
+        return counts
+
+    def completed_in_deadline(self) -> int:
+        """FINISHED requests whose completion stamp met their deadline (no
+        deadline counts as met) — the numerator of the goodput fraction.
+        The step-boundary sweep expires over-deadline rows, but a request
+        can still finish DURING the step that crossed its deadline — those
+        count as misses here even though they produced tokens."""
+        n = 0
+        for req in self.requests.values():
+            if req.state is not RequestState.FINISHED:
+                continue
+            if (req.deadline_s is None or req.finish_time is None
+                    or req.finish_time - req.submit_time <= req.deadline_s):
+                n += 1
+        return n
+
     def stats(self) -> Dict[str, Any]:
         return {
             "steps": self.steps_run,
@@ -343,9 +582,14 @@ class DecodeEngine:
             "preemptions": self.scheduler.preemptions,
             "admissions": self.scheduler.admissions,
             "aborts": self.aborts,
+            "expired": self.scheduler.expired,
+            "rejected": self.scheduler.rejected,
+            "pinned": self.scheduler.pins,
+            "watchdog_recoveries": self.watchdog_recoveries,
             "kv_pool_bytes": pool_bytes(self.pools),
             "kv_blocks_peak": self.allocator.peak_used,
             "kv_blocks_free": self.allocator.free_blocks,
             "failed_allocs": self.allocator.failed_allocs,
             "compiled_widths": sorted(self._steps),
+            "outcomes": self.outcome_counts(),
         }

@@ -69,8 +69,12 @@ class BlockAllocator:
     """Host-side free-list allocator over the pool's block ids.
 
     Block 0 is reserved as the null page (never handed out); allocation
-    and free are O(n) list ops on python ints — deterministic, no device
-    traffic.  ``peak_used`` / ``failed_allocs`` feed the engine's stats.
+    and free are O(1)-per-block ops on python ints — deterministic, no
+    device traffic.  A set mirror of the free list makes double-free
+    detection O(1) (it was an O(free) scan per freed block — quadratic on
+    the watchdog's reclaim-everything path).  ``peak_used`` /
+    ``failed_allocs`` feed the engine's stats; :attr:`all_free` is the
+    leak oracle the overload/fault drills pin after every terminal state.
     """
 
     def __init__(self, num_blocks: int):
@@ -79,6 +83,7 @@ class BlockAllocator:
                 f"need >= 2 KV blocks (1 null + 1 usable), got {num_blocks}")
         self.num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._free_set = set(self._free)
         self.peak_used = 0
         self.failed_allocs = 0
 
@@ -90,6 +95,14 @@ class BlockAllocator:
     def used_blocks(self) -> int:
         return (self.num_blocks - 1) - len(self._free)
 
+    @property
+    def all_free(self) -> bool:
+        """True when every allocable block is back on the free list — the
+        no-leak invariant every request's terminal transition (FINISHED,
+        ABORTED, EXPIRED, REJECTED, preempted, watchdog-replayed) must
+        restore once no request holds a table."""
+        return len(self._free) == self.num_blocks - 1
+
     def allocate(self, n: int) -> List[int]:
         """``n`` block ids, or :class:`OutOfBlocks` (nothing handed out —
         all-or-nothing, so a failed grab never leaks)."""
@@ -99,16 +112,20 @@ class BlockAllocator:
                 f"KV pool exhausted: requested {n} blocks, "
                 f"{len(self._free)} free of {self.num_blocks - 1}")
         out = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(out)
         self.peak_used = max(self.peak_used, self.used_blocks)
         return out
 
     def free(self, blocks: List[int]) -> None:
+        if len(set(blocks)) != len(blocks):
+            raise ValueError(f"duplicate block ids in free(): {blocks}")
         for b in blocks:
             if not 1 <= b < self.num_blocks:
                 raise ValueError(f"freeing unknown block id {b}")
-            if b in self._free:
+            if b in self._free_set:
                 raise ValueError(f"double free of block {b}")
         self._free.extend(reversed(blocks))
+        self._free_set.update(blocks)
 
 
 def init_paged_pools(*, num_layers: int, num_kv_heads: int, head_dim: int,

@@ -9,7 +9,10 @@ The request lifecycle::
 
     WAITING --admit--> PREFILL --prompt done--> DECODE --eos/max--> FINISHED
        ^                  |                        |
-       +---- preempt -----+------------------------+      (abort -> ABORTED)
+       +---- preempt -----+------------------------+
+                                       (abort -> ABORTED,
+                                        deadline/TTL -> EXPIRED,
+                                        load shed   -> REJECTED)
 
 One unifying invariant drives every transition: a request's *pending*
 tokens are ``(prompt + out_tokens)[num_computed:]`` — the tokens not yet
@@ -22,20 +25,56 @@ the vLLM "recompute" policy: on re-admission the prompt AND the tokens
 generated so far re-prefill, which under greedy decoding reproduces the
 identical continuation, so a preempted request is slower, never wrong.
 
+Robustness layer (the serving-under-fire contract):
+
+* **Deadlines & TTLs** — ``Request.deadline_s`` is an end-to-end wall
+  budget from submission; ``max_queue_s`` bounds time spent WAITING.
+  Both are checked at STEP BOUNDARIES (``schedule()``): an exceeded
+  request transitions to the terminal ``EXPIRED`` state with its blocks
+  reclaimed through the same path an abort takes — distinct from
+  ``ABORTED`` so operators can tell "caller cancelled" from "we were too
+  slow".  Admission never starts a request whose remaining budget cannot
+  cover even its prompt's minimum prefill time (``ceil(prompt /
+  prefill_chunk)`` steps at the observed EWMA step cost) — it expires
+  immediately (reason ``budget``) instead of wasting pool space on a
+  guaranteed miss.
+* **Admission control / load shedding** — ``max_waiting`` bounds the
+  queue; an over-full queue sheds per ``shed_policy``
+  (``serving.shed_policy``): ``reject_newest`` (default: the newcomer
+  bounces), ``reject_oldest`` (head-drop: freshest traffic wins), or
+  ``by_deadline`` (the request with the least remaining budget — the one
+  most likely to miss anyway — is dropped; no-deadline requests count as
+  infinite budget and shed newest-first among themselves).  Shedding is
+  a typed :class:`RequestRejected` outcome returned from :meth:`add`,
+  NEVER an exception out of the engine loop.
+* **Preemption-storm breaker** — a request preempted
+  ``max_preemptions`` times is **pinned**: never victimized again (all
+  policies' victim selection skips pinned rows), so sustained overload
+  degrades to queueing instead of recompute livelock.  A pinned
+  requester that cannot grow its own table still parks itself — that
+  frees its blocks for others, so progress is preserved.
+* **Starvation-free sjf** — the ``sjf`` key ages with queue time:
+  ``effective = work / (1 + waited_ticks / sjf_aging_steps)``, tie-broken
+  by remaining deadline budget then arrival.  A long job's effective
+  priority improves every scheduler tick it waits, so sustained
+  short-job arrivals can delay it, never starve it (tier-1 pinned).
+
 Scheduling policies (``serving.scheduler_policy``):
 
 * ``fcfs`` — admission and preemption-victim order by arrival: oldest
-  admits first, youngest is preempted first (a preempted elder re-admits
-  ahead of the request that displaced it).
-* ``sjf``  — shortest pending work first (arrival breaks ties): better
-  p50 under mixed lengths, starvation-prone under sustained load.
+  admits first, youngest unpinned is preempted first (a preempted elder
+  re-admits ahead of the request that displaced it).
+* ``sjf``  — shortest pending work first with aging (above): better p50
+  under mixed lengths without the textbook starvation failure.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Dict, List, Optional, Sequence
+import math
+import time
+from typing import Callable, Dict, List, Optional, Sequence
 
 from automodel_tpu.serving.kv_cache import (
     BlockAllocator,
@@ -48,6 +87,14 @@ from automodel_tpu.utils.fault_injection import InjectedFault, fault_point
 # load like cp_layout / moe.dispatch — see loader._enum_fields).
 SCHEDULER_POLICIES = ("fcfs", "sjf")
 DEFAULT_SCHEDULER_POLICY = "fcfs"
+
+# ``serving.shed_policy`` config domain: what a FULL waiting queue drops.
+SHED_POLICIES = ("reject_newest", "reject_oldest", "by_deadline")
+DEFAULT_SHED_POLICY = "reject_newest"
+
+# Queue ticks of waiting that halve an sjf job's effective length (the
+# aging rate; see the module docstring).  One tick == one schedule() call.
+DEFAULT_SJF_AGING_STEPS = 32
 
 
 def normalize_scheduler_policy(v):
@@ -67,15 +114,40 @@ def validate_scheduler_policy(v: Optional[str]) -> Optional[str]:
     return v
 
 
+def normalize_shed_policy(v):
+    from automodel_tpu.config.loader import normalize_null_spelling
+
+    return normalize_null_spelling(v)
+
+
+def validate_shed_policy(v: Optional[str]) -> Optional[str]:
+    if v is None:
+        return None
+    if v not in SHED_POLICIES:
+        raise ValueError(
+            f"serving.shed_policy must be one of {list(SHED_POLICIES)} "
+            f"(or null for the default), got {v!r}")
+    return v
+
+
 class RequestState(enum.Enum):
     WAITING = "waiting"
     PREFILL = "prefill"
     DECODE = "decode"
     FINISHED = "finished"
     ABORTED = "aborted"
+    # Terminal robustness states — distinct so telemetry/operators can tell
+    # "caller cancelled" (ABORTED) from "deadline/TTL ran out" (EXPIRED)
+    # from "admission control dropped it" (REJECTED).
+    EXPIRED = "expired"
+    REJECTED = "rejected"
 
 
-@dataclasses.dataclass
+# Requests compare by IDENTITY (eq=False), never by field value: two
+# requests with identical prompts are still distinct units of work, and
+# value equality silently corrupts ``req in waiting`` / ``waiting.remove``
+# bookkeeping (the slot-reuse aliasing bug class — tier-1 pinned).
+@dataclasses.dataclass(eq=False)
 class Request:
     """One serving request and its cache bookkeeping."""
 
@@ -90,6 +162,19 @@ class Request:
     slot: Optional[int] = None     # step-buffer row while active
     arrival: int = 0               # admission-order tiebreak
     preemptions: int = 0
+    # -- robustness layer --------------------------------------------------
+    deadline_s: Optional[float] = None   # end-to-end budget from submit
+    max_queue_s: Optional[float] = None  # WAITING-time TTL
+    submit_time: float = 0.0             # scheduler-clock stamp at add()
+    submit_tick: int = 0                 # schedule()-tick stamp at add()
+    pinned: bool = False                 # never victimized once set
+    finish_reason: Optional[str] = None  # why a terminal state was entered
+    finish_time: Optional[float] = None  # clock stamp at the terminal state
+    # Parked in-flight rows (preempted / watchdog-replayed) re-enter the
+    # waiting list but are NOT queue traffic: shedding, drain rejection
+    # and the queue TTL all treat them as admitted work — only the
+    # deadline (and pool pressure) governs them after first admission.
+    was_admitted: bool = False           # ever held a step slot
 
     @property
     def seq(self) -> List[int]:
@@ -101,7 +186,27 @@ class Request:
 
     @property
     def finished(self) -> bool:
-        return self.state in (RequestState.FINISHED, RequestState.ABORTED)
+        return self.state in (RequestState.FINISHED, RequestState.ABORTED,
+                              RequestState.EXPIRED, RequestState.REJECTED)
+
+    def remaining_budget(self, now: float) -> float:
+        """Seconds of deadline budget left (inf without a deadline)."""
+        if self.deadline_s is None:
+            return math.inf
+        return self.deadline_s - (now - self.submit_time)
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestRejected:
+    """The typed load-shed outcome: admission control dropped ``rid``.
+
+    Returned from :meth:`Scheduler.add` / recorded by the engine — never
+    raised, so an overloaded engine loop keeps stepping instead of
+    unwinding (the serving-under-fire contract)."""
+
+    rid: int
+    reason: str                 # queue_full | draining | shed(injected)
+    policy: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -131,22 +236,46 @@ class Scheduler:
 
     def __init__(self, allocator: BlockAllocator, *, max_num_seqs: int,
                  prefill_chunk: int, block_size: int, max_model_len: int,
-                 policy: str = DEFAULT_SCHEDULER_POLICY):
+                 policy: str = DEFAULT_SCHEDULER_POLICY,
+                 max_waiting: Optional[int] = None,
+                 shed_policy: str = DEFAULT_SHED_POLICY,
+                 max_preemptions: Optional[int] = None,
+                 sjf_aging_steps: int = DEFAULT_SJF_AGING_STEPS,
+                 clock: Callable[[], float] = time.monotonic):
         policy = validate_scheduler_policy(normalize_scheduler_policy(policy))
+        shed_policy = validate_shed_policy(
+            normalize_shed_policy(shed_policy))
         self.allocator = allocator
         self.max_num_seqs = max_num_seqs
         self.prefill_chunk = prefill_chunk
         self.block_size = block_size
         self.max_model_len = max_model_len
         self.policy = policy or DEFAULT_SCHEDULER_POLICY
+        self.max_waiting = max_waiting
+        self.shed_policy = shed_policy or DEFAULT_SHED_POLICY
+        self.max_preemptions = max_preemptions
+        self.sjf_aging_steps = sjf_aging_steps or DEFAULT_SJF_AGING_STEPS
+        self.clock = clock
+        self.draining = False
         self.waiting: List[Request] = []
         self.slots: List[Optional[Request]] = [None] * max_num_seqs
         self._arrivals = 0
+        self._ticks = 0                # schedule() calls (the aging clock)
+        self._step_time_ewma: Optional[float] = None
         self.preemptions = 0
         self.admissions = 0
+        self.expired = 0
+        self.rejected = 0
+        self.pins = 0
 
     # -- intake ------------------------------------------------------------
-    def add(self, req: Request) -> None:
+    def add(self, req: Request) -> List[RequestRejected]:
+        """Queue one request.  Returns the typed :class:`RequestRejected`
+        outcomes this admission caused — empty when ``req`` simply joined
+        the queue; under ``reject_oldest`` the victim may be a DIFFERENT
+        (older) request.  Impossible requests (can never fit the pool /
+        model length) still raise ``ValueError``: that is a caller bug,
+        not load."""
         total = len(req.prompt) + req.max_new_tokens
         if total > self.max_model_len:
             raise ValueError(
@@ -162,14 +291,84 @@ class Scheduler:
                 "serving.num_kv_blocks / max_model_len")
         req.arrival = self._arrivals
         self._arrivals += 1
+        req.submit_time = self.clock()
+        req.submit_tick = self._ticks
         req.state = RequestState.WAITING
+        if self.draining:
+            return [self._reject(req, "draining")]
+        # The drilled load-shed site: an armed ``serve_shed`` behaves
+        # exactly like a full waiting queue — the contract is a typed
+        # rejection, never an exception out of the engine loop.
+        try:
+            fault_point("serve_shed")
+        except InjectedFault:
+            return [self._reject(req, "shed(injected)")]
+        out: List[RequestRejected] = []
+        if self.max_waiting is not None:
+            now = req.submit_time
+            while len(self.waiting) >= self.max_waiting:
+                victim = self._shed_victim(req, now)
+                out.append(self._reject(victim, "queue_full"))
+                if victim is req:
+                    return out
         self.waiting.append(req)
+        return out
+
+    def _shed_victim(self, newcomer: Request, now: float) -> Request:
+        # Parked in-flight rows (preempted / watchdog-replayed) are never
+        # shed candidates: they are admitted work — rejecting them would
+        # discard generated tokens and re-victimize pinned requests.  When
+        # the queue holds nothing BUT parked rows, the newcomer bounces.
+        fresh = [r for r in self.waiting if not r.was_admitted]
+        if self.shed_policy == "reject_oldest":
+            if not fresh:
+                return newcomer
+            return min(fresh, key=lambda r: r.arrival)
+        if self.shed_policy == "by_deadline":
+            # drop the request most likely to miss anyway: least remaining
+            # budget first; no-deadline requests (inf budget) shed
+            # newest-first among themselves
+            return min(fresh + [newcomer],
+                       key=lambda r: (r.remaining_budget(now), -r.arrival))
+        return newcomer                                  # reject_newest
+
+    def _reject(self, req: Request, reason: str) -> RequestRejected:
+        if req in self.waiting:
+            self.waiting.remove(req)
+        req.state = RequestState.REJECTED
+        req.finish_reason = reason
+        req.finish_time = self.clock()
+        self.rejected += 1
+        return RequestRejected(rid=req.rid, reason=reason,
+                               policy=self.shed_policy)
 
     def abort(self, req: Request) -> None:
-        """Cancel anywhere in the lifecycle: frees the block table, vacates
-        the slot — the ``serve_request_abort`` contract."""
+        """Cancel anywhere in the lifecycle: frees the block table
+        IMMEDIATELY (mid-chunked-prefill included — partially-written KV
+        blocks return to the free list right here, never deferred to the
+        next ``schedule()``), vacates the slot — the
+        ``serve_request_abort`` contract."""
         if req.finished:
             return
+        self._release(req)
+        req.state = RequestState.ABORTED
+        req.finish_reason = "abort"
+        req.finish_time = self.clock()
+
+    def expire(self, req: Request, reason: str = "deadline") -> None:
+        """Deadline/TTL cancellation: same reclaim path as an abort but the
+        terminal state is EXPIRED — "we were too slow", not "caller
+        cancelled"."""
+        if req.finished:
+            return
+        self._release(req)
+        req.state = RequestState.EXPIRED
+        req.finish_reason = reason
+        req.finish_time = self.clock()
+        self.expired += 1
+
+    def _release(self, req: Request) -> None:
+        """Vacate slot + return the whole block table to the free list."""
         if req in self.waiting:
             self.waiting.remove(req)
         if req.slot is not None:
@@ -178,7 +377,22 @@ class Scheduler:
         if req.blocks:
             self.allocator.free(req.blocks)
             req.blocks = []
-        req.state = RequestState.ABORTED
+
+    def requeue_for_replay(self, req: Request) -> None:
+        """Watchdog recovery: park an admitted request back to WAITING with
+        its blocks reclaimed and ``num_computed`` reset — the recompute
+        replay re-prefills prompt + generated-so-far, so greedy output
+        stays token-identical.  The replayed request is PINNED (never
+        re-victimized) so recovery cannot stack preemptions on top of the
+        stall it just absorbed."""
+        if req.finished:
+            return
+        self._release(req)
+        req.num_computed = 0
+        req.state = RequestState.WAITING
+        req.pinned = True
+        if req not in self.waiting:
+            self.waiting.append(req)
 
     @property
     def active(self) -> List[Request]:
@@ -187,11 +401,24 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting or self.active)
 
+    def note_step_time(self, seconds: float) -> None:
+        """Feed one observed device-step wall time into the EWMA the
+        admission budget check prices prefill steps with."""
+        if seconds <= 0:
+            return
+        if self._step_time_ewma is None:
+            self._step_time_ewma = seconds
+        else:
+            self._step_time_ewma = 0.5 * self._step_time_ewma + 0.5 * seconds
+
     # -- internals ---------------------------------------------------------
-    def _policy_key(self, req: Request):
+    def _policy_key(self, req: Request, now: float):
         if self.policy == "sjf":
-            return (len(req.pending) + req.max_new_tokens
-                    - len(req.out_tokens), req.arrival)
+            work = (len(req.pending) + req.max_new_tokens
+                    - len(req.out_tokens))
+            waited = self._ticks - req.submit_tick
+            aged = work / (1.0 + waited / float(self.sjf_aging_steps))
+            return (aged, req.remaining_budget(now), req.arrival)
         return req.arrival                                   # fcfs
 
     def _allocate(self, n: int) -> List[int]:
@@ -212,13 +439,19 @@ class Scheduler:
         victim.state = RequestState.WAITING
         victim.preemptions += 1
         self.preemptions += 1
+        if (self.max_preemptions is not None and not victim.pinned
+                and victim.preemptions >= self.max_preemptions):
+            # the preemption-storm breaker: from here on this request is
+            # never re-victimized, so recompute cannot livelock
+            victim.pinned = True
+            self.pins += 1
         self.waiting.append(victim)
 
     def _ensure_blocks(self, req: Request, new_total: int) -> bool:
         """Grow ``req``'s block table to cover ``new_total`` positions,
-        preempting strictly-younger active requests (youngest first) while
-        the pool is exhausted; parks ``req`` itself when it is the
-        youngest.  Returns False when ``req`` was preempted."""
+        preempting strictly-younger UNPINNED active requests (youngest
+        first) while the pool is exhausted; parks ``req`` itself when no
+        victim remains.  Returns False when ``req`` was preempted."""
         need = blocks_needed(new_total, self.block_size) - len(req.blocks)
         while True:
             try:
@@ -227,7 +460,8 @@ class Scheduler:
                 return True
             except (OutOfBlocks, InjectedFault) as e:
                 younger = [r for r in self.active
-                           if r is not req and r.arrival > req.arrival]
+                           if r is not req and r.arrival > req.arrival
+                           and not r.pinned]
                 if younger:
                     self._preempt(max(younger, key=lambda r: r.arrival))
                     continue
@@ -236,7 +470,10 @@ class Scheduler:
                     # an injected alloc failure is always absorbed as a
                     # preemption (the drilled contract: never a crash);
                     # genuine exhaustion only raises in the provably
-                    # impossible solo-request-no-blocks state below
+                    # impossible solo-request-no-blocks state below.  A
+                    # pinned requester still parks ITSELF — that is not a
+                    # victimization, and holding a half-grown table would
+                    # deadlock the pool.
                     self._preempt(req)
                     return False
                 raise OutOfBlocks(
@@ -244,11 +481,56 @@ class Scheduler:
                     f"blocks, pool has {self.allocator.num_blocks - 1} "
                     "total — raise serving.num_kv_blocks")
 
-    def _admit(self) -> None:
-        for req in sorted(self.waiting, key=self._policy_key):
+    def _min_prefill_s(self, req: Request) -> Optional[float]:
+        """Lower bound on wall time to prefill ``req``'s pending tokens —
+        ``ceil(pending / prefill_chunk)`` steps at the EWMA step cost
+        (None before any step has been observed)."""
+        if self._step_time_ewma is None:
+            return None
+        steps = blocks_needed(len(req.pending), self.prefill_chunk)
+        return steps * self._step_time_ewma
+
+    def _expire_due(self, now: float) -> None:
+        """The step-boundary deadline sweep (active AND waiting rows),
+        plus queue-TTL enforcement on waiting rows."""
+        # The drilled deadline site: an armed ``serve_deadline`` models
+        # the oldest active request's deadline firing right now —
+        # terminal EXPIRED, blocks reclaimed, every other row unaffected.
+        try:
+            fault_point("serve_deadline")
+        except InjectedFault:
+            victims = self.active
+            if victims:
+                self.expire(min(victims, key=lambda r: r.arrival),
+                            reason="deadline(injected)")
+        for req in list(self.active):
+            if req.remaining_budget(now) <= 0:
+                self.expire(req, reason="deadline")
+        for req in list(self.waiting):
+            if req.remaining_budget(now) <= 0:
+                self.expire(req, reason="deadline")
+            elif (not req.was_admitted and req.max_queue_s is not None
+                    and now - req.submit_time > req.max_queue_s):
+                # the TTL is an ADMISSION bound ("drop me if I can't even
+                # start within X"): a request that was admitted, ran, and
+                # was parked back is in-flight work — discarding its
+                # generated tokens on a queue timer would be a silent
+                # data loss; only its deadline governs it now
+                self.expire(req, reason="queue_ttl")
+
+    def _admit(self, now: float) -> None:
+        for req in sorted(self.waiting,
+                          key=lambda r: self._policy_key(r, now)):
             free_slots = [i for i, r in enumerate(self.slots) if r is None]
             if not free_slots:
                 return
+            min_prefill = self._min_prefill_s(req)
+            if (min_prefill is not None
+                    and req.remaining_budget(now) < min_prefill):
+                # a guaranteed deadline miss never occupies a slot: expire
+                # at the admission boundary instead of wasting pool space
+                self.expire(req, reason="budget")
+                continue
             first_chunk = min(len(req.pending), self.prefill_chunk)
             if self.allocator.free_blocks * self.block_size < first_chunk:
                 continue         # in-flight admission waits for frees
@@ -256,13 +538,19 @@ class Scheduler:
             req.slot = free_slots[0]
             self.slots[req.slot] = req
             req.state = RequestState.PREFILL
+            req.was_admitted = True
             self.admissions += 1
 
     # -- the per-step contract --------------------------------------------
-    def schedule(self) -> Optional[StepPlan]:
-        """Admit what fits, grow block tables (preempting under pressure),
-        and emit this step's :class:`StepPlan` — or None when idle."""
-        self._admit()
+    def schedule(self, now: Optional[float] = None) -> Optional[StepPlan]:
+        """Expire what ran out of time, admit what fits, grow block tables
+        (preempting under pressure), and emit this step's
+        :class:`StepPlan` — or None when idle."""
+        if now is None:
+            now = self.clock()
+        self._ticks += 1
+        self._expire_due(now)
+        self._admit(now)
         if not self.active:
             return None
         width = self.prefill_chunk if any(
@@ -285,17 +573,23 @@ class Scheduler:
                 # num_computed reset, so the stale RowWork must not run
                 rows[i] = None
         if not any(r is not None for r in rows):
-            return self.schedule() if self.has_work() else None
+            return self.schedule(now) if self.has_work() else None
         return StepPlan(rows=rows, step_width=width)
 
     def finish_step(self, plan: StepPlan,
                     sampled: Dict[int, int]) -> List[Request]:
         """Apply one executed plan: advance ``num_computed``, append the
         sampled token where the pending list emptied, retire finished
-        requests (freeing their blocks).  ``sampled`` maps slot -> token."""
+        requests (freeing their blocks).  ``sampled`` maps slot -> token.
+        Rows whose request reached a terminal state mid-step (an abort or
+        watchdog expiry issued between ``schedule()`` and here) are
+        skipped — their blocks were already reclaimed and their replay
+        state must not be advanced by stale device results."""
         done: List[Request] = []
         for work in plan.active:
             req = work.req
+            if req.finished or req.slot is None:
+                continue
             req.num_computed += len(work.tokens)
             if not work.samples_next:
                 continue
@@ -310,6 +604,8 @@ class Scheduler:
                     self.allocator.free(req.blocks)
                     req.blocks = []
                 req.state = RequestState.FINISHED
+                req.finish_reason = "eos" if hit_eos else "length"
+                req.finish_time = self.clock()
                 done.append(req)
             else:
                 req.state = RequestState.DECODE

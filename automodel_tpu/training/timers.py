@@ -109,6 +109,43 @@ def restore_time_by_source(elapsed: Dict[str, float]) -> Dict[str, float]:
             for name in RESTORE_TIMERS}
 
 
+# Serving-robustness timers + outcome accounting (serving/engine.py,
+# tools/serve.py, the bench serve leg): ``serve_step`` accumulates device
+# step wall time, ``serve_drain`` the graceful-drain window after
+# SIGTERM/SIGINT, ``serve_recovery`` the host time watchdog recoveries
+# spent reclaiming tables and rebuilding pools.  The outcome-rate helpers
+# below read ``DecodeEngine.outcome_counts()``-shaped dicts (state-name ->
+# request count) — the four numbers the serving acceptance bar pins under
+# a 2x-capacity overload trace.
+SERVE_TIMERS = ("serve_step", "serve_drain", "serve_recovery")
+
+
+def serve_shed_rate(outcomes: Dict[str, int]) -> float:
+    """Fraction of submitted requests admission control REJECTED (load
+    shedding + drain rejections) — rises with overload by design: a shed
+    request cost nothing but a queue check."""
+    total = sum(outcomes.values())
+    return outcomes.get("rejected", 0) / total if total else 0.0
+
+
+def serve_expired_rate(outcomes: Dict[str, int]) -> float:
+    """Fraction of submitted requests that ran out of deadline/TTL budget
+    after being accepted (terminal EXPIRED) — the number that should stay
+    LOW even under overload: admission control exists to convert
+    would-be expiries into cheap rejections."""
+    total = sum(outcomes.values())
+    return outcomes.get("expired", 0) / total if total else 0.0
+
+
+def serve_goodput_fraction(completed_in_deadline: int,
+                           outcomes: Dict[str, int]) -> float:
+    """Completed-before-deadline fraction of ALL submitted requests — the
+    serving analogue of the elastic goodput number: work that arrived,
+    was admitted, finished, and met its budget."""
+    total = sum(outcomes.values())
+    return completed_in_deadline / total if total else 1.0
+
+
 # Pipeline-parallel bubble accounting (training/pipeline.py): every
 # optimizer step's microbatch loop runs ``k + warmup`` slots per
 # grad-accumulation microbatch, of which ``warmup`` (the fill) plus the

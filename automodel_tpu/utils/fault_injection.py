@@ -120,6 +120,28 @@ Serving-engine points (see ``serving/scheduler.py`` / ``serving/engine.py``):
                       the oldest active request is aborted, its whole
                       block table returns to the free list immediately,
                       and every other request's output is unaffected.
+    serve_deadline    in ``Scheduler._expire_due``, the step-boundary
+                      deadline sweep — models the oldest ACTIVE request's
+                      deadline firing right now.  Contract: the victim
+                      transitions to the terminal EXPIRED state (distinct
+                      from ABORTED) with its whole block table reclaimed,
+                      and every other request's greedy output is
+                      unaffected — never a crash, never a leaked block.
+    serve_shed        in ``Scheduler.add`` — models admission control
+                      dropping the incoming request exactly like a full
+                      waiting queue.  Contract: a typed RequestRejected
+                      outcome (state REJECTED, no blocks ever held),
+                      NEVER an exception out of the engine loop.
+    serve_watchdog_stall
+                      in ``DecodeEngine.step``, at the device-step
+                      dispatch — stands in for a wedged step (the runtime
+                      surfacing a timeout/cancellation after
+                      ``serving.watchdog_s`` without slot progress).
+                      Contract: the engine aborts the in-flight batch,
+                      rebuilds the pools, reclaims every block table, and
+                      replays the admitted requests from their last
+                      computed token (pinned; greedy output stays
+                      token-identical through the recovery).
 """
 
 from __future__ import annotations
@@ -156,6 +178,9 @@ KNOWN_FAULT_POINTS = frozenset({
     "ckpt_replica_restore",
     "serve_block_alloc",
     "serve_request_abort",
+    "serve_deadline",
+    "serve_shed",
+    "serve_watchdog_stall",
 })
 
 

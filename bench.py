@@ -180,7 +180,10 @@ SECONDARY = {
     # Poisson arrival trace (drawn host-side up front — no randomness in
     # jitted code) through the engine's continuous-batching loop; reports
     # requests_s plus serve_p50_ms / serve_p99_ms end-to-end latency as
-    # extra secondary keys.
+    # extra secondary keys.  A second 2x-capacity OVERLOAD pass with
+    # per-request deadlines + bounded queue + by_deadline shedding adds
+    # the serving-under-fire numbers: shed_rate, expired_rate,
+    # goodput_fraction and overload_p99_ms (p99 of admitted requests).
     "serve": [],
     # Pipeline-parallel leg (docs/guides/distributed.md "Pipeline
     # parallelism"; BENCH_PP=0 skips): handled by _pipeline_secondary_main
@@ -723,9 +726,37 @@ def _serve_decode_secondary_main() -> None:
                       "vs_baseline": round(bN / b1, 4)}))
 
 
+def _drive_arrival_trace(eng, prompts, arrivals, *, deadline_s=None,
+                         max_queue_s=None):
+    """Step an engine through a host-drawn arrival trace; returns
+    (wall_s, {rid: latency_s of completed}, rids)."""
+    n_req = len(prompts)
+    lat = {}
+    t0 = time.perf_counter()
+    submitted = 0
+    rids = {}
+    while submitted < n_req or eng.scheduler.has_work():
+        now = time.perf_counter() - t0
+        while submitted < n_req and arrivals[submitted] <= now:
+            rids[eng.submit(prompts[submitted], deadline_s=deadline_s,
+                            max_queue_s=max_queue_s)] = submitted
+            submitted += 1
+        done = eng.step()
+        now = time.perf_counter() - t0
+        for req in done:
+            if req.rid in rids:
+                lat[req.rid] = now - arrivals[rids[req.rid]]
+        if not eng.scheduler.has_work() and submitted < n_req:
+            # the next arrival's offset may already be in the past when the
+            # engine drained mid-step — never hand sleep() a negative
+            time.sleep(max(0.0, min(0.001, arrivals[submitted] - now)))
+    return time.perf_counter() - t0, lat, rids
+
+
 def _serve_trace_secondary_main() -> None:
     """Child process: requests/s + p50/p99 latency under a seeded
-    deterministic Poisson arrival trace.
+    deterministic Poisson arrival trace, plus the 2x-capacity OVERLOAD
+    trace's robustness numbers.
 
     The whole trace (inter-arrival exponentials + prompt ids) is drawn
     HOST-SIDE up front from one seeded generator — nothing random near the
@@ -734,10 +765,26 @@ def _serve_trace_secondary_main() -> None:
     latency is completion minus (offset-adjusted) arrival.  Absolute ms on
     a dev host is not chip-meaningful — the leg exists so the latency
     distribution stays BOUNDED run over run and the continuous-batching
-    path is exercised under bursty arrivals.  ``BENCH_SERVE=0`` skips.
+    path is exercised under bursty arrivals.
+
+    The overload pass re-runs the trace at 2x the measured unloaded
+    request rate with per-request deadlines, a bounded waiting queue and
+    ``by_deadline`` shedding, and reports the serving-under-fire
+    acceptance numbers: ``shed_rate`` (admission-control rejections),
+    ``expired_rate`` (deadline/TTL misses after admission),
+    ``goodput_fraction`` (completed before deadline / all submitted), and
+    ``overload_p99_ms`` (p99 latency of ADMITTED-and-completed requests —
+    shed requests cost a queue check, not a latency sample).
+    ``BENCH_SERVE=0`` skips.
     """
     if os.environ.get("BENCH_SERVE", "1") == "0":
         raise SystemExit("BENCH_SERVE=0: serving legs skipped")
+    from automodel_tpu.training.timers import (
+        serve_expired_rate,
+        serve_goodput_fraction,
+        serve_shed_rate,
+    )
+
     model, params = _serve_model()
     n_req, max_new, seqs = (6, 8, 4) if SMALL else (32, 24, 8)
     rng = np.random.default_rng(0)
@@ -757,32 +804,51 @@ def _serve_trace_secondary_main() -> None:
     per_req = time.perf_counter() - probe0
     arrivals = np.cumsum(rng.exponential(per_req / 2, size=n_req))
 
-    lat = {}
-    t0 = time.perf_counter()
-    submitted = 0
-    rids = {}
-    while submitted < n_req or eng.scheduler.has_work():
-        now = time.perf_counter() - t0
-        while submitted < n_req and arrivals[submitted] <= now:
-            rids[eng.submit(prompts[submitted])] = submitted
-            submitted += 1
-        done = eng.step()
-        now = time.perf_counter() - t0
-        for req in done:
-            if req.rid in rids:
-                lat[req.rid] = now - arrivals[rids[req.rid]]
-        if not eng.scheduler.has_work() and submitted < n_req:
-            # the next arrival's offset may already be in the past when the
-            # engine drained mid-step — never hand sleep() a negative
-            time.sleep(max(0.0, min(0.001, arrivals[submitted] - now)))
-    wall = time.perf_counter() - t0
+    wall, lat, _ = _drive_arrival_trace(eng, prompts, arrivals)
     ms = np.asarray(sorted(lat.values())) * 1e3
+    unloaded_rate = n_req / wall
+
+    # -- the 2x-capacity overload pass (fresh engine, robustness knobs) ----
+    from automodel_tpu.generation import GenerationConfig
+    from automodel_tpu.serving import DecodeEngine, ServingConfig
+
+    over = DecodeEngine(
+        model, params,
+        ServingConfig(kv_block_size=16, max_num_seqs=seqs,
+                      max_model_len=32 + max_new, prefill_chunk=32,
+                      max_waiting=seqs, shed_policy="by_deadline",
+                      max_preemptions=2),
+        generation=GenerationConfig(max_new_tokens=max_new))
+    over.submit(prompts[0])        # warm the fresh engine's widths
+    over.run()
+    arrivals2 = np.cumsum(rng.exponential(
+        1.0 / (2.0 * unloaded_rate), size=n_req))
+    # deadline ~ a few unloaded service times: tight enough that a 2x
+    # backlog genuinely sheds/expires, loose enough that admitted work
+    # mostly completes
+    deadline_s = max(4.0 * per_req, 0.05)
+    wall2, lat2, rids2 = _drive_arrival_trace(
+        over, prompts, arrivals2, deadline_s=deadline_s,
+        max_queue_s=deadline_s / 2)
+    outcomes = {state: n for state, n in over.outcome_counts().items()}
+    # exclude the warm-up request from the rate denominators
+    outcomes["finished"] = outcomes.get("finished", 1) - 1
+    lat2_ms = np.asarray(sorted(lat2.values())) * 1e3
+
     print(json.dumps({
-        "tps": round(n_req / wall, 2),
-        "requests_s": round(n_req / wall, 2),
+        "tps": round(unloaded_rate, 2),
+        "requests_s": round(unloaded_rate, 2),
         "serve_p50_ms": round(float(np.percentile(ms, 50)), 2),
         "serve_p99_ms": round(float(np.percentile(ms, 99)), 2),
         "serve_preemptions": eng.scheduler.preemptions,
+        "shed_rate": round(serve_shed_rate(outcomes), 4),
+        "expired_rate": round(serve_expired_rate(outcomes), 4),
+        "goodput_fraction": round(serve_goodput_fraction(
+            over.completed_in_deadline() - 1, outcomes), 4),
+        "overload_p99_ms": round(float(np.percentile(lat2_ms, 99)), 2)
+        if len(lat2_ms) else None,
+        "overload_requests_s": round(n_req / wall2, 2),
+        "overload_pins": over.scheduler.pins,
     }))
 
 

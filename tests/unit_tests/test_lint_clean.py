@@ -313,6 +313,58 @@ def test_cli_fails_on_a_seeded_violation(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Fault-coverage gate: every KNOWN_FAULT_POINTS entry wired AND drilled
+# (tools/fault_coverage.py — the operator-readable generalization of L005)
+# ---------------------------------------------------------------------------
+def test_fault_coverage_report_is_gap_free():
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        from fault_coverage import build_report
+    finally:
+        sys.path.pop(0)
+    report = build_report(_REPO)
+    assert report["ok"], (
+        f"fault-injection coverage gaps — undrilled: {report['undrilled']}, "
+        f"unwired: {report['unwired']}, unregistered call sites: "
+        f"{report['unregistered_call_sites']} (run tools/fault_coverage.py "
+        "for the full report; every point needs a pytest.mark.fault drill)")
+    # the report is complete: one row per registered point, each naming
+    # its call sites and at least one drilling test module
+    assert report["registered"] == len(report["points"]) >= 19
+    for row in report["points"]:
+        assert row["call_sites"] and row["drilled_by"], row
+
+
+def test_fault_coverage_cli_and_gap_detection(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "fault_coverage.py"),
+         "--format", "json"],
+        capture_output=True, text=True, cwd=_REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["ok"] is True
+    # a synthetic repo with a registered-but-undrilled point must fail
+    pkg = tmp_path / "automodel_tpu" / "utils"
+    pkg.mkdir(parents=True)
+    (pkg / "fault_injection.py").write_text(
+        "KNOWN_FAULT_POINTS = frozenset({'lonely_point'})\n"
+        "def fault_point(name):\n    pass\n")
+    (tmp_path / "automodel_tpu" / "hot.py").write_text(
+        "from automodel_tpu.utils.fault_injection import fault_point\n"
+        "def f():\n    fault_point('lonely_point')\n")
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "tests").mkdir()
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        from fault_coverage import build_report
+    finally:
+        sys.path.pop(0)
+    report = build_report(str(tmp_path))
+    assert not report["ok"]
+    assert report["undrilled"] == ["lonely_point"]
+    assert report["points"][0]["call_sites"] == ["automodel_tpu/hot.py:3"]
+
+
+# ---------------------------------------------------------------------------
 # L007 — ppermute confined to ops/ + training/train_step.py
 # ---------------------------------------------------------------------------
 def test_l007_flags_ppermute_outside_its_homes():

@@ -1,0 +1,107 @@
+"""tools/serve.py: the operator drive's CI contract — nonzero exit with a
+per-terminal-state summary whenever any request did not finish, fault-spec
+arming for drills, and drain-on-signal wiring (stub-handler level; the
+signal trap itself is sig_utils, drilled in its own suite)."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_YAML = os.path.join(_REPO, "examples", "serve", "tiny_llama_serve.yaml")
+
+
+@pytest.fixture(scope="module")
+def serve_tool():
+    spec = importlib.util.spec_from_file_location(
+        "serve_tool_under_test", os.path.join(_REPO, "tools", "serve.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run(serve_tool, capsys, argv):
+    rc = serve_tool.main(argv)
+    out = capsys.readouterr().out.strip().splitlines()
+    return rc, json.loads(out[-1])
+
+
+def test_exits_zero_and_reports_outcomes_when_all_finish(serve_tool,
+                                                         capsys):
+    rc, report = _run(serve_tool, capsys, [
+        "--config", _YAML, "--requests", "3", "--max-new", "3"])
+    assert rc == 0
+    assert report["not_finished"] == 0 and report["drained"] is False
+    # warm-up request + the 3 driven ones, all finished
+    assert report["outcomes"] == {"finished": 4}
+    assert report["expired"] == 0 and report["rejected"] == 0
+    assert "serve_step" in report["timers_ms"]
+
+
+def test_exits_nonzero_with_summary_on_aborted_requests(serve_tool,
+                                                        capsys):
+    """The CI-drill satellite: a synthetic drive that ends with an aborted
+    request must NOT exit 0, and the summary names the terminal states."""
+    rc, report = _run(serve_tool, capsys, [
+        "--config", _YAML, "--requests", "3", "--max-new", "3",
+        "--fault", "serve_request_abort:2"])
+    assert rc == 1
+    assert report["outcomes"].get("aborted") == 1
+    assert report["not_finished"] == 1
+    assert report["aborts"] == 1
+
+
+def test_exits_nonzero_when_deadlines_expire(serve_tool, capsys):
+    rc, report = _run(serve_tool, capsys, [
+        "--config", _YAML, "--requests", "3", "--max-new", "3",
+        "--fault", "serve_deadline:2"])
+    assert rc == 1
+    assert report["outcomes"].get("expired") == 1
+    assert report["expired"] == 1
+
+
+def test_watchdog_recovery_still_exits_zero(serve_tool, capsys):
+    """A drilled stall is RECOVERED, not fatal: every request replays to
+    completion and the drive exits clean — with the recovery counted."""
+    rc, report = _run(serve_tool, capsys, [
+        "--config", _YAML, "--requests", "3", "--max-new", "3",
+        "--watchdog-s", "30", "--fault", "serve_watchdog_stall:2"])
+    assert rc == 0
+    assert report["watchdog_recoveries"] == 1
+    assert report["not_finished"] == 0
+
+
+class _TrippedHandler:
+    received = True
+
+
+def test_drive_drains_when_signal_handler_trips(serve_tool):
+    """_drive consults the signal handler each loop turn: a received
+    signal drains the engine (waiting rejected, in-flight finished within
+    the grace bound) instead of hard-exiting mid-request."""
+    import jax
+
+    from automodel_tpu.config.loader import load_yaml_config
+    from automodel_tpu.generation import GenerationConfig
+    from automodel_tpu.serving import DecodeEngine, build_serving_config
+
+    cfg = load_yaml_config(_YAML)
+    model = cfg.model.instantiate()
+    params = model.init(jax.random.key(0))
+    eng = DecodeEngine(model, params, build_serving_config(cfg),
+                       generation=GenerationConfig(max_new_tokens=3))
+    out = serve_tool._drive(
+        eng, [[3, 4, 5], [6, 7]], deadline_s=None, max_queue_s=None,
+        drain_grace_s=None, handler=_TrippedHandler())
+    assert out["drained"] is True
+    states = {r.state.value for r in eng.requests.values()}
+    assert states <= {"finished", "rejected"}
+    assert eng.scheduler.draining and not eng.scheduler.has_work()
+    assert eng.allocator.all_free
+    # and once draining, later submissions bounce as typed rejections
+    rid = eng.submit([8, 9])
+    assert eng.requests[rid].state.value == "rejected"

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Operator-readable fault-injection coverage report.
+
+Cross-references ``utils/fault_injection.py::KNOWN_FAULT_POINTS`` against
+the repo's actual crash-site call sites and the ``pytest.mark.fault`` test
+surface, and reports — per point — where it fires and which test modules
+drill it.  This generalizes lint rule L005 (which flags an undrilled point
+as a finding) into the report an operator reads before trusting a
+production rollout: every named crash site must have (a) at least one
+call site in the package and (b) at least one fault-marked test whose
+source names it.
+
+    python tools/fault_coverage.py                # text report
+    python tools/fault_coverage.py --format json  # machine-readable
+
+Exit status: 0 when every registered point is both wired and drilled;
+1 on any gap — always strict, so a bare invocation gates CI.  The
+lint-gate tier-1 test runs this tool, so a fault point can never ship
+undrilled.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from typing import Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from automodel_tpu.analysis.lint import _known_fault_points, _repo_root
+
+
+def _call_sites(repo_root: str) -> Dict[str, List[str]]:
+    """point name -> ["relpath:line", ...] for every ``fault_point("...")``
+    call in the package + tools (AST-level, like the linter — no string
+    matching on comments/docstrings)."""
+    sites: Dict[str, List[str]] = {}
+    for top in ("automodel_tpu", "tools"):
+        base = os.path.join(repo_root, top)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if not d.startswith((".", "__"))]
+            for fn in filenames:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                try:
+                    tree = ast.parse(open(path).read())
+                except (OSError, SyntaxError):
+                    continue
+                rel = os.path.relpath(path, repo_root)
+                for node in ast.walk(tree):
+                    if not (isinstance(node, ast.Call)
+                            and getattr(node.func, "id",
+                                        getattr(node.func, "attr", None))
+                            == "fault_point" and node.args):
+                        continue
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Constant) and isinstance(
+                            arg.value, str):
+                        sites.setdefault(arg.value, []).append(
+                            f"{rel}:{node.lineno}")
+    return sites
+
+
+def _drilled_by(repo_root: str) -> Dict[str, List[str]]:
+    """point name -> test modules that use the ``fault`` marker AND name
+    the point in their source (the same coverage surface L005 checks)."""
+    out: Dict[str, List[str]] = {}
+    points = _known_fault_points(repo_root)
+    tests_dir = os.path.join(repo_root, "tests")
+    for dirpath, dirnames, filenames in os.walk(tests_dir):
+        dirnames[:] = [d for d in dirnames if not d.startswith((".", "__"))]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                text = open(path).read()
+            except OSError:
+                continue
+            if "mark.fault" not in text:
+                continue
+            rel = os.path.relpath(path, repo_root)
+            for name in points:
+                if name in text:
+                    out.setdefault(name, []).append(rel)
+    return out
+
+
+def build_report(repo_root: str = None) -> dict:
+    root = repo_root or _repo_root()
+    points = sorted(_known_fault_points(root))
+    sites = _call_sites(root)
+    drills = _drilled_by(root)
+    rows = []
+    for name in points:
+        rows.append({
+            "point": name,
+            "call_sites": sorted(sites.get(name, [])),
+            "drilled_by": sorted(drills.get(name, [])),
+        })
+    unwired = [r["point"] for r in rows if not r["call_sites"]]
+    undrilled = [r["point"] for r in rows if not r["drilled_by"]]
+    # call sites naming a point that was never registered are L005 findings
+    # — surfaced here too so the report is self-contained
+    unregistered = sorted(set(sites) - set(points))
+    return {
+        "points": rows,
+        "registered": len(points),
+        "unwired": unwired,
+        "undrilled": undrilled,
+        "unregistered_call_sites": {n: sites[n] for n in unregistered},
+        "ok": not (unwired or undrilled or unregistered),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+    report = build_report()
+    if args.format == "json":
+        print(json.dumps(report, indent=2))
+    else:
+        for row in report["points"]:
+            mark = "ok " if row["call_sites"] and row["drilled_by"] \
+                else "GAP"
+            print(f"[{mark}] {row['point']}")
+            for s in row["call_sites"]:
+                print(f"       fires at {s}")
+            if not row["call_sites"]:
+                print("       !! no call site in the package")
+            for t in row["drilled_by"]:
+                print(f"       drilled by {t}")
+            if not row["drilled_by"]:
+                print("       !! no pytest.mark.fault test names this point")
+        for n, sites in report["unregistered_call_sites"].items():
+            print(f"[GAP] {n} — called but NOT in KNOWN_FAULT_POINTS: "
+                  f"{', '.join(sites)}")
+        print(f"{report['registered']} registered points; "
+              f"{len(report['undrilled'])} undrilled, "
+              f"{len(report['unwired'])} unwired, "
+              f"{len(report['unregistered_call_sites'])} unregistered")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -6,10 +6,26 @@ Loads a serving YAML (model + ``serving:`` knobs, see
 drives synthetic prompts — or, with ``--eval``, the config's
 ``validation_dataset`` rows through the greedy-continuation scorer — and
 prints one JSON report: tokens/s, engine stats (preemptions, peak blocks,
-compiled widths), and the eval score when asked.
+compiled widths), the per-terminal-state outcome summary, and the eval
+score when asked.
+
+Robustness drills (docs/guides/serving.md "Production hardening"):
+
+* SIGTERM/SIGINT trigger a **graceful drain** — stop admitting, finish
+  in-flight work within ``--drain-grace-s`` (default:
+  ``serving.drain_grace_s``), then expire stragglers with their blocks
+  reclaimed — mirroring the trainer's preemption grace window.  A second
+  ^C still aborts a hung run (sig_utils chaining).
+* ``--fault`` arms a fault-injection spec (``serve_block_alloc:3,...``)
+  for CI drills without touching the environment.
+* The exit code is **0 only when every driven request FINISHED**; any
+  aborted/expired/rejected/unfinished request exits 1 with the summary
+  printed — so a CI drill that silently sheds work cannot pass.
 
     python tools/serve.py --config examples/serve/tiny_llama_serve.yaml
     python tools/serve.py --config ... --requests 32 --kv-dtype int8
+    python tools/serve.py --config ... --deadline-s 30 --watchdog-s 10
+    python tools/serve.py --config ... --fault serve_watchdog_stall:3
     python tools/serve.py --config ... --eval --limit 16
 """
 
@@ -18,6 +34,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import sys
 import time
 
@@ -26,7 +43,38 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main() -> int:
+def _drive(engine, prompts, *, deadline_s, max_queue_s, drain_grace_s,
+           handler) -> dict:
+    """Submit every prompt and step to completion, draining on a trapped
+    signal.  Returns {"wall_s": ..., "drained": bool}.  Carries the same
+    stall bound as ``engine.run()``: a scheduler wedge is a loud
+    RuntimeError, never a silent CI hang."""
+    t0 = time.perf_counter()
+    drained = False
+    for p in prompts:
+        engine.submit(p, deadline_s=deadline_s, max_queue_s=max_queue_s)
+    from automodel_tpu.serving.kv_cache import blocks_needed
+
+    max_steps = 64 + 8 * sum(
+        blocks_needed(len(r.prompt), engine.config.prefill_chunk)
+        + r.max_new_tokens + 1
+        for r in engine.requests.values() if not r.finished)
+    steps = 0
+    while engine.scheduler.has_work():
+        if handler is not None and handler.received:
+            engine.drain(drain_grace_s)
+            drained = True
+            break
+        engine.step()
+        steps += 1
+        if steps > max_steps:
+            raise RuntimeError(
+                f"engine made no progress within {max_steps} steps — "
+                "scheduler stall (file a bug with the request trace)")
+    return {"wall_s": time.perf_counter() - t0, "drained": drained}
+
+
+def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", "-c", required=True)
     ap.add_argument("--requests", type=int, default=16,
@@ -37,24 +85,48 @@ def main() -> int:
                     help="override serving.kv_cache_dtype (e.g. int8)")
     ap.add_argument("--policy", default=None,
                     help="override serving.scheduler_policy")
+    ap.add_argument("--deadline-s", type=float, default=None,
+                    help="per-request end-to-end deadline (None: unbounded)")
+    ap.add_argument("--max-queue-s", type=float, default=None,
+                    help="per-request WAITING-time TTL (None: unbounded)")
+    ap.add_argument("--watchdog-s", type=float, default=None,
+                    help="override serving.watchdog_s")
+    ap.add_argument("--max-waiting", type=int, default=None,
+                    help="override serving.max_waiting (queue bound)")
+    ap.add_argument("--shed-policy", default=None,
+                    help="override serving.shed_policy")
+    ap.add_argument("--drain-grace-s", type=float, default=None,
+                    help="drain window after SIGTERM/SIGINT "
+                         "(default: serving.drain_grace_s)")
+    ap.add_argument("--fault", default=None,
+                    help="arm a fault-injection spec for CI drills, e.g. "
+                         "'serve_block_alloc:3,serve_watchdog_stall:5'")
     ap.add_argument("--eval", action="store_true",
                     help="score the config's validation_dataset instead")
     ap.add_argument("--limit", type=int, default=16,
                     help="eval rows (with --eval)")
     ap.add_argument("--seed", type=int, default=0)
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     import jax
 
     from automodel_tpu.config.loader import load_yaml_config
     from automodel_tpu.generation import GenerationConfig
     from automodel_tpu.serving import DecodeEngine, build_serving_config
+    from automodel_tpu.training.timers import SERVE_TIMERS, Timers
+    from automodel_tpu.utils import fault_injection as fi
+    from automodel_tpu.utils.sig_utils import DistributedSignalHandler
 
     cfg = load_yaml_config(args.config)
-    if args.kv_dtype is not None:
-        cfg.set_by_dotted("serving.kv_cache_dtype", args.kv_dtype)
-    if args.policy is not None:
-        cfg.set_by_dotted("serving.scheduler_policy", args.policy)
+    for flag, dotted in (("kv_dtype", "serving.kv_cache_dtype"),
+                         ("policy", "serving.scheduler_policy"),
+                         ("watchdog_s", "serving.watchdog_s"),
+                         ("max_waiting", "serving.max_waiting"),
+                         ("shed_policy", "serving.shed_policy"),
+                         ("drain_grace_s", "serving.drain_grace_s")):
+        v = getattr(args, flag)
+        if v is not None:
+            cfg.set_by_dotted(dotted, v)
     scfg = build_serving_config(cfg)
     model = cfg.model.instantiate()
     params = model.init(jax.random.key(args.seed))
@@ -73,7 +145,11 @@ def main() -> int:
         print(json.dumps(report))
         return 0
 
-    engine = DecodeEngine(model, params, scfg, generation=gen)
+    if args.fault:
+        fi.configure_faults(args.fault)
+    timers = Timers()
+    engine = DecodeEngine(model, params, scfg, generation=gen,
+                          timers=timers)
     vocab = model.config.vocab_size
     rng = np.random.default_rng(args.seed)
     prompts = [rng.integers(1, vocab, int(n)).tolist()
@@ -82,18 +158,40 @@ def main() -> int:
                    args.requests)]
     engine.submit(prompts[0])          # warm compiles off the clock
     engine.run()
-    t0 = time.perf_counter()
-    for p in prompts:
-        engine.submit(p)
-    engine.run()
-    dt = time.perf_counter() - t0
+    # GKE preemption (SIGTERM) and operator ^C both take the graceful
+    # drain; a SECOND ^C chains the default handler so a hung drain stays
+    # abortable — the trainer's grace-window pattern.
+    with DistributedSignalHandler([signal.SIGTERM, signal.SIGINT]) as h:
+        drive = _drive(engine, prompts, deadline_s=args.deadline_s,
+                       max_queue_s=args.max_queue_s,
+                       drain_grace_s=args.drain_grace_s
+                       if args.drain_grace_s is not None
+                       else scfg.drain_grace_s, handler=h)
+    if args.fault:
+        fi.reset_faults()
     stats = engine.stats()
-    print(json.dumps({
+    outcomes = engine.outcome_counts()
+    # the warm-up request is part of self.requests: it finished pre-drive
+    not_finished = sum(n for state, n in outcomes.items()
+                       if state != "finished")
+    dt = drive["wall_s"]
+    report = {
         "requests": args.requests,
         "decode_tok_s": round(args.requests * gen.max_new_tokens / dt, 1),
         "wall_s": round(dt, 3),
+        "drained": drive["drained"],
+        "not_finished": not_finished,
+        "timers_ms": {n: round(v * 1e3, 2) for n, v in
+                      timers.get_elapsed(names=list(SERVE_TIMERS),
+                                         reset=False).items()},
         **stats,
-    }))
+    }
+    print(json.dumps(report))
+    if not_finished:
+        print(f"serve: {not_finished} request(s) did not finish "
+              f"(outcomes: {outcomes}) — exiting nonzero for CI",
+              file=sys.stderr)
+        return 1
     return 0
 
 
