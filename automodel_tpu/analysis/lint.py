@@ -106,7 +106,8 @@ _MOVED_IMPORT_FROMS: Dict[Tuple[str, str], str] = {
 # config domain (the convention CP_LAYOUTS / MOE_DISPATCHES established).
 _ENUM_CONST_RE = re.compile(
     r"^_?[A-Z][A-Z0-9_]*(LAYOUTS|DISPATCHES|MODES|SCHEMES|STRATEGIES|"
-    r"POLICIES|BACKENDS|FORMATS|KINDS|CHOICES|DTYPES|RECIPES|SCHEDULES)$")
+    r"POLICIES|BACKENDS|FORMATS|KINDS|CHOICES|DTYPES|RECIPES|SCHEDULES|"
+    r"ALGORITHMS|SOURCES)$")
 
 # L003: banned call chains inside jit scope.
 _WALLCLOCK_CALLS = {

@@ -363,6 +363,8 @@ def _enum_fields():
     from automodel_tpu.ops.moe import MOE_DISPATCHES
     from automodel_tpu.ops.quant import QUANT_DTYPES, QUANT_RECIPES
     from automodel_tpu.ops.zigzag import CP_LAYOUTS
+    from automodel_tpu.post_training.losses import PT_ALGORITHMS
+    from automodel_tpu.post_training.rollout import REWARD_SOURCES
     from automodel_tpu.serving.kv_cache import KV_CACHE_DTYPES
     from automodel_tpu.serving.scheduler import (
         SCHEDULER_POLICIES,
@@ -380,6 +382,8 @@ def _enum_fields():
         "serving.scheduler_policy": SCHEDULER_POLICIES,
         "serving.shed_policy": SHED_POLICIES,
         "pipeline.schedule": PP_SCHEDULES,
+        "post_training.algorithm": PT_ALGORITHMS,
+        "rl.reward_source": REWARD_SOURCES,
     }
 
 
@@ -407,11 +411,20 @@ _BOOL_FIELDS = ("checkpoint.async_save", "checkpoint.replicate_to_peers")
 # the pipelined step's trace.
 _POSITIVE_INT_FIELDS = ("pipeline.pp_size", "pipeline.num_microbatches",
                         "serving.max_waiting", "serving.max_preemptions",
-                        "serving.sjf_aging_steps")
+                        "serving.sjf_aging_steps",
+                        # post-training rollout geometry (a typo'd group
+                        # size must fail at load, not as a reshape error in
+                        # the advantage normalizer)
+                        "rl.group_size", "rl.rollout_batch_size",
+                        "rl.max_new_tokens", "rl.max_prompt_len",
+                        "post_training.max_steps")
 
 # Positive-number (int or float) fields: wall-clock windows where 0/negative
-# is always a typo ("null" disables the feature instead).
-_POSITIVE_NUM_FIELDS = ("serving.watchdog_s", "serving.drain_grace_s")
+# is always a typo ("null" disables the feature instead).  rl.kl_coef null
+# disables the KL penalty (the reference-free GRPO memory option);
+# rl.beta null means the DPO default.
+_POSITIVE_NUM_FIELDS = ("serving.watchdog_s", "serving.drain_grace_s",
+                        "rl.kl_coef", "rl.beta")
 
 
 def normalize_null_spelling(v: Any) -> Any:

@@ -108,3 +108,44 @@ def build_classification_dataset(
             "labels": sent[0] % num_labels,
         })
     return examples
+
+
+def build_preference_pairs_dataset(
+    *,
+    num_pairs: int = 64,
+    prompt_len: int = 8,
+    mean_len: float = 8.0,
+    std_len: float = 2.0,
+    vocab_size: int = 100,
+    max_completion_len: int = 16,
+    seed: int = 0,
+    tokenizer=None,
+) -> List[Dict[str, List[int]]]:
+    """Synthetic DPO preference pairs: ``{prompt_ids, chosen_ids,
+    rejected_ids}`` rows (the schema ``recipes/llm/train_dpo.py``
+    consumes; map real preference sets onto it).
+
+    The preference signal is LEARNABLE by construction: chosen
+    completions draw from the lower half of the vocabulary, rejected from
+    the upper half — a tiny model's DPO accuracy/margin must move in a
+    few steps, which is what the tier-1 recipe test pins."""
+    random.seed(seed)
+    vocab = make_vocab(vocab_size)
+    words = list(vocab.values())[2:]
+    mid = max(len(words) // 2, 1)
+    lo, hi = words[:mid], words[mid:] or words
+
+    def completion(pool):
+        L = max(1, min(max_completion_len,
+                       int(random.gauss(mean_len, std_len))))
+        return random.choices(pool, k=L) + [vocab["<eos>"]]
+
+    examples = []
+    for _ in range(num_pairs):
+        prompt = random.choices(words, k=max(1, prompt_len))
+        examples.append({
+            "prompt_ids": prompt,
+            "chosen_ids": completion(lo),
+            "rejected_ids": completion(hi),
+        })
+    return examples

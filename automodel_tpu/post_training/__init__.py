@@ -1,0 +1,42 @@
+"""Post-training workloads riding the decode engine on one mesh.
+
+The reference framework's post-training story runs logprob inference
+through a host-side unshard context (``parallelizer.unshard_fsdp2_model``,
+SURVEY.md §113) — a non-starter on TPU pods, where the whole point is that
+parameters never fit (or belong) on one host.  This package is the
+TPU-native shape of that workload class:
+
+    post_training/
+      logprobs.py   sharding-preserving per-token logprob pass — the train
+                    step's census-pinned forward + linear-CE-style chunked
+                    lse/pick, so full logits never materialize and no new
+                    collective kinds appear vs the train forward
+      losses.py     GRPO (group-normalized advantages, clipped PG + k3 KL)
+                    and DPO objectives — pure jnp, independently testable
+      steps.py      jitted GRPO/DPO optimizer steps sharing the train
+                    step's plan/optimizer/metrics plumbing
+      rollout.py    the rollout layer: drives the PR-12 serving engine
+                    against the LIVE training params via the explicit
+                    weight-handoff API (``DecodeEngine.update_params``),
+                    grouped sampled completions, reward computation
+      base.py       the shared recipe base + RL state (reward EMA, rollout
+                    counters) that round-trips through the PR-1/5 async
+                    checkpoint protocol
+      eval_watch.py online-eval checkpoint watcher: scores each COMMITTED
+                    checkpoint through ``serving/eval.py`` on a cadence
+
+The recipes live with their siblings in ``recipes/llm/train_grpo.py`` and
+``recipes/llm/train_dpo.py``; docs in ``docs/guides/post_training.md``.
+"""
+
+from automodel_tpu.post_training.logprobs import (   # noqa: F401
+    build_logprob_fn,
+    completion_logprobs,
+    make_sequence_batch,
+)
+from automodel_tpu.post_training.losses import (     # noqa: F401
+    PT_ALGORITHMS,
+    dpo_losses,
+    group_normalized_advantages,
+    grpo_token_objective,
+)

@@ -1,0 +1,354 @@
+"""Shared post-training recipe base: one mesh, rollouts + training.
+
+``PostTrainingRecipeBase`` owns everything GRPO and DPO share — the mesh /
+model / plan / optimizer construction, the frozen reference policy, the
+decode engine + weight-handoff worker, the jitted logprob pass, RL state
+that round-trips through the PR-1/5 async checkpoint protocol, the online
+eval hook, and the checkpoint cadence.  The algorithm recipes
+(``recipes/llm/train_grpo.py`` / ``train_dpo.py``) contribute only their
+step builder and their per-step data path.
+
+Deliberately NOT wired in v1 (each is a documented follow-up, not a
+silent degradation): PEFT adapters, quantized compute (``fp8:``), pipeline
+parallelism, per-step LR schedules — a config carrying those sections
+fails loudly here rather than training something subtly different.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from automodel_tpu.checkpoint.checkpointing import build_checkpoint_config
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.distributed.init import initialize_distributed
+from automodel_tpu.distributed.mesh import MeshManager
+from automodel_tpu.distributed.shardings import build_parallel_plan
+from automodel_tpu.generation.generate import GenerationConfig
+from automodel_tpu.optim import build_optimizer
+from automodel_tpu.post_training.logprobs import build_logprob_fn
+from automodel_tpu.post_training.rollout import (
+    RolloutWorker,
+    build_rollout_config,
+)
+from automodel_tpu.recipes.base_recipe import BaseRecipe
+from automodel_tpu.serving.engine import DecodeEngine, build_serving_config
+from automodel_tpu.training.rng import StatefulRNG
+from automodel_tpu.training.timers import Timers
+
+logger = logging.getLogger(__name__)
+
+_UNSUPPORTED_SECTIONS = ("peft", "fp8", "pipeline", "freeze_config")
+
+
+class RLState:
+    """Post-training host state that must survive checkpoint/resume
+    EXACTLY (reward EMA, rollout/step counters, the data cursor) — a
+    plain ``state_dict``/``load_state_dict`` object, so
+    :class:`~automodel_tpu.recipes.base_recipe.BaseRecipe`'s attribute
+    tracker checkpoints it through the same crash-safe (and async)
+    protocol as everything else."""
+
+    def __init__(self, ema_beta: float = 0.9):
+        self.step = 0                 # optimizer steps taken
+        self.rollouts = 0             # successful rollouts
+        self.failed_rollouts = 0      # typed RolloutError skips
+        self.data_cursor = 0          # prompt/pair stream position
+        self.tokens_generated = 0     # completion tokens across rollouts
+        self.reward_ema: Optional[float] = None
+        self.reward_last: Optional[float] = None
+        self.ema_beta = float(ema_beta)
+
+    def note_rollout(self, mean_reward: float, tokens: int) -> None:
+        self.rollouts += 1
+        self.tokens_generated += int(tokens)
+        self.reward_last = float(mean_reward)
+        if self.reward_ema is None:
+            self.reward_ema = float(mean_reward)
+        else:
+            self.reward_ema = (self.ema_beta * self.reward_ema
+                               + (1.0 - self.ema_beta) * float(mean_reward))
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "step": self.step,
+            "rollouts": self.rollouts,
+            "failed_rollouts": self.failed_rollouts,
+            "data_cursor": self.data_cursor,
+            "tokens_generated": self.tokens_generated,
+            "reward_ema": self.reward_ema,
+            "reward_last": self.reward_last,
+            "ema_beta": self.ema_beta,
+        }
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        for k, v in sd.items():
+            setattr(self, k, v)
+
+
+class PostTrainingRecipeBase(BaseRecipe):
+    """``setup()`` then ``run_post_training_loop()``."""
+
+    # subclasses pin their algorithm name; validated against the YAML's
+    # ``post_training.algorithm`` so a GRPO config cannot silently drive
+    # the DPO recipe (and vice versa)
+    algorithm: str = ""
+    # offline algorithms (DPO) skip the decode engine + rollout worker —
+    # no KV pools allocated for a workload that never generates
+    uses_engine: bool = True
+
+    def __init__(self, cfg: ConfigNode):
+        super().__init__()
+        self.cfg = cfg
+
+    # -- setup -------------------------------------------------------------
+    def setup(self):
+        cfg = self.cfg
+        for section in _UNSUPPORTED_SECTIONS:
+            if cfg.get(section) is not None:
+                raise ValueError(
+                    f"post-training recipes do not support the "
+                    f"{section!r} config section yet (see docs/guides/"
+                    "post_training.md, 'Scope'); remove it")
+        from automodel_tpu.config.loader import normalize_null_spelling
+
+        algo = normalize_null_spelling(cfg.get("post_training.algorithm"))
+        if algo is not None and algo != self.algorithm:
+            raise ValueError(
+                f"post_training.algorithm={algo!r} does not match this "
+                f"recipe ({type(self).__name__} runs {self.algorithm!r})")
+        self.dist_info = initialize_distributed(
+            **(cfg.get("dist_env").to_dict()
+               if cfg.get("dist_env") is not None else {}))
+        self._setup_compile_cache(cfg)
+        rng_cfg = cfg.get("rng")
+        self.rng = StatefulRNG(
+            seed=int(rng_cfg.get("seed", 42)) if rng_cfg else 42,
+            ranked=bool(rng_cfg.get("ranked", False)) if rng_cfg else False)
+
+        # Mesh + model + plan (the train step's — rollouts share it)
+        dist_cfg = cfg.get("distributed")
+        if isinstance(dist_cfg, ConfigNode) and "_target_" in dist_cfg:
+            self.mesh_manager = dist_cfg.instantiate()
+        else:
+            self.mesh_manager = MeshManager(
+                **(dist_cfg.to_dict() if dist_cfg is not None else {}))
+        self.model = cfg.get("model").instantiate()
+        self.plan = build_parallel_plan(self.model, self.mesh_manager)
+        self.param_sharding = self.plan.param_sharding
+
+        # Rollout + loop knobs (validated at load AND re-validated here)
+        self.rollout_config = build_rollout_config(cfg.get("rl"))
+        pt = cfg.get("post_training")
+        self.max_steps = int(pt.get("max_steps", 20)) if pt else 20
+        self.ckpt_every_steps = int(
+            pt.get("ckpt_every_steps", 0) or 0) if pt else 0
+        self.log_every_steps = int(pt.get("log_every_steps", 1)) if pt else 1
+        self.max_consecutive_failures = int(
+            pt.get("max_consecutive_failures", 3)) if pt else 3
+
+        # Optimizer (constant LR in v1; schedules are a follow-up)
+        opt_cfg = cfg.get("optimizer")
+        opt_kwargs = {k: v
+                      for k, v in (opt_cfg.to_dict() if opt_cfg else {}).items()
+                      if k != "_target_"}
+        target = opt_cfg.get("_target_") if opt_cfg is not None else None
+        if isinstance(target, str):
+            opt_kwargs.setdefault("name", target.rsplit(".", 1)[-1].lower())
+        max_gn = cfg.get("max_grad_norm")
+        if max_gn is not None:
+            opt_kwargs.setdefault("grad_clip_norm", float(max_gn))
+        self.optimizer = build_optimizer(**opt_kwargs)
+
+        # Jitted machinery: the algorithm step + the shared logprob pass
+        self.step_fns = self._build_step_fns()
+        self.logprob_fn = build_logprob_fn(self.model, self.plan)
+
+        # Params (HF stream-in or fresh init), optimizer state
+        ckpt_dir = getattr(self.model, "checkpoint_dir", None)
+        if ckpt_dir is not None:
+            from automodel_tpu.models.hf_io import load_hf_weights
+
+            self.params = load_hf_weights(self.model, ckpt_dir,
+                                          shardings=self.param_sharding)
+        else:
+            with self.rng:
+                self.params = jax.jit(
+                    self.model.init,
+                    out_shardings=self.param_sharding)(self.rng.next_key())
+        self.opt_state = self.step_fns.init_opt_state(self.params)
+
+        # Frozen reference policy: a genuine DEVICE copy at the plan's
+        # shardings (params are donated every step, so aliasing the live
+        # tree would hand the reference dead buffers).  GRPO with
+        # ``rl.kl_coef: null`` skips the copy entirely — the
+        # reference-free memory option (docs/guides/post_training.md,
+        # "Reference-policy memory").  DPO always needs one.
+        self._ref_params = (self._device_copy(self.params)
+                            if self._needs_reference() else None)
+
+        # The decode engine on the SAME mesh: rollouts consume the live
+        # params through the weight-handoff API; the engine's decode plan
+        # is the train plan's placement (device-to-device resharding is
+        # then the identity until the plans diverge).
+        rc = self.rollout_config
+        self.engine = None
+        self.rollout_worker = None
+        if self.uses_engine:
+            self.serving_config = build_serving_config(cfg.get("serving"))
+            gen = GenerationConfig(
+                max_new_tokens=rc.max_new_tokens,
+                do_sample=rc.temperature > 0,
+                temperature=max(rc.temperature, 1e-6),
+                top_k=rc.top_k, top_p=rc.top_p,
+                eos_token_id=rc.eos_token_id, pad_token_id=rc.pad_token_id)
+            self.engine = DecodeEngine(
+                self.model, self.params, self.serving_config,
+                generation=gen, param_sharding=self.param_sharding,
+                sample_seed=(rc.seed if rc.seed is not None
+                             else self.rng.seed), timers=None)
+            self.rollout_worker = RolloutWorker(self.engine, rc)
+
+        # Host state that must round-trip exactly
+        self.rl_state = RLState()
+        self.timers = Timers()
+        self.checkpoint_config = build_checkpoint_config(cfg.get("checkpoint"))
+        self._setup_data()
+        self._setup_online_eval()
+        # resume if a committed checkpoint exists (params, opt state, AND
+        # rl_state through the tracked-stateful path)
+        self.load_checkpoint()
+        return self
+
+    def _needs_reference(self) -> bool:
+        raise NotImplementedError
+
+    def _build_step_fns(self):
+        raise NotImplementedError
+
+    def _setup_data(self) -> None:
+        raise NotImplementedError
+
+    def _device_copy(self, tree):
+        copy = jax.jit(lambda t: jax.tree.map(lambda x: x.copy(), t),
+                       out_shardings=self.param_sharding)
+        return copy(tree)
+
+    def _setup_online_eval(self) -> None:
+        """The optional in-recipe online-eval hook (``online_eval:``):
+        a background CheckpointEvalWatcher scoring committed checkpoints;
+        the loop only drains its results for logging — training never
+        blocks on scoring."""
+        self.eval_watcher = None
+        oe = self.cfg.get("online_eval")
+        if oe is None or not bool(oe.get("enabled", True)):
+            return
+        if not self.checkpoint_config.enabled:
+            logger.warning(
+                "online_eval: requires checkpointing (the watcher scores "
+                "COMMITTED checkpoints); disabled for this run")
+            return
+        from automodel_tpu.post_training.eval_watch import (
+            CheckpointEvalWatcher,
+            rows_from_eval_config,
+        )
+
+        section = str(oe.get("dataset_section", "validation_dataset"))
+        rows = rows_from_eval_config(
+            self.cfg, section=section,
+            limit=int(oe.get("limit", 8)))
+        self.eval_watcher = CheckpointEvalWatcher(
+            self.model, self.checkpoint_config.checkpoint_dir, rows,
+            via=str(oe.get("via", "engine")),
+            max_new_tokens=(int(oe.get("max_new_tokens"))
+                            if oe.get("max_new_tokens") else None),
+            checkpoint_config=self.checkpoint_config,
+            poll_interval_s=float(oe.get("poll_interval_s", 10.0)))
+        self.eval_watcher.start()
+
+    # -- shared loop plumbing ----------------------------------------------
+    def _maybe_checkpoint(self, step: int, final: bool = False) -> None:
+        if not self.checkpoint_config.enabled:
+            return
+        due = (self.ckpt_every_steps
+               and step % self.ckpt_every_steps == 0)
+        if final and getattr(self, "_last_ckpt_step", -1) == step:
+            return
+        if due or final:
+            self.save_checkpoint(0, step)
+            self._last_ckpt_step = step
+
+    def _drain_eval_results(self) -> List[Dict[str, Any]]:
+        if self.eval_watcher is None:
+            return []
+        return self.eval_watcher.drain_results()
+
+    def _log_metrics(self, step: int, metrics: Dict[str, float],
+                     extra: str = "") -> None:
+        if not self.dist_info.is_main or step % self.log_every_steps:
+            return
+        body = " | ".join(f"{k} {v:.4f}" for k, v in metrics.items()
+                          if k != "_packed")
+        logger.info("step %d | %s%s", step, body, extra)
+        for res in self._drain_eval_results():
+            logger.info("step %d | online eval of ckpt step %d: "
+                        "eval/score %.4f", step, res["step"],
+                        res["eval/score"])
+
+    def teardown(self, raise_error: bool = True) -> None:
+        # join the in-flight async commit FIRST: the watcher's final poll
+        # can only see COMMITTED checkpoints, and the end-of-training save
+        # is usually still on the committer thread when teardown starts
+        super().teardown(raise_error=raise_error)
+        if getattr(self, "eval_watcher", None) is not None:
+            # the final committed checkpoint deserves a score before the
+            # watcher dies with the process
+            try:
+                self.eval_watcher.stop(final_poll=True)
+            except Exception:
+                logger.warning("online-eval final poll failed",
+                               exc_info=True)
+
+    # -- the loop (subclasses implement one optimizer step) ----------------
+    def run_post_training_loop(self):
+        state = self.rl_state
+        consecutive_failures = 0
+        from automodel_tpu.post_training.rollout import RolloutError
+
+        try:
+            while state.step < self.max_steps:
+                step = state.step + 1
+                t0 = time.perf_counter()
+                try:
+                    metrics = self._one_step(step)
+                except RolloutError as e:
+                    state.failed_rollouts += 1
+                    consecutive_failures += 1
+                    logger.warning(
+                        "step %d rollout failed (%d consecutive): %s — "
+                        "training state untouched, retrying with the next "
+                        "rollout", step, consecutive_failures, e)
+                    if consecutive_failures >= self.max_consecutive_failures:
+                        raise RuntimeError(
+                            f"{consecutive_failures} consecutive rollout "
+                            "failures — aborting (raise post_training."
+                            "max_consecutive_failures to tolerate more)"
+                        ) from e
+                    continue
+                consecutive_failures = 0
+                state.step = step
+                metrics["step_time"] = time.perf_counter() - t0
+                self._log_metrics(step, metrics)
+                self._maybe_checkpoint(step)
+            self._maybe_checkpoint(state.step, final=True)
+        except BaseException:
+            self.teardown(raise_error=False)
+            raise
+        self.teardown()
+        return self
+
+    def _one_step(self, step: int) -> Dict[str, float]:
+        raise NotImplementedError

@@ -1,0 +1,228 @@
+"""Sharding-preserving per-token logprob pass.
+
+The reference computes post-training logprobs by UNSHARDING the model onto
+the host and running a dense forward (``parallelizer.unshard_fsdp2_model``,
+SURVEY.md §113) — at TPU-pod scale that is an OOM by design.  Here the
+logprob pass IS the train step's forward:
+
+* the model runs ``return_hidden=True`` under the SAME ``sharding_context``
+  as the train step, so every FSDP gather / TP collective is the one the
+  golden census already pins — the pass adds **no new collective kinds**
+  (tier-1 pinned, ``tests/unit_tests/test_post_training.py``);
+* per-token logprobs come from the fused-linear-CE machinery
+  (``loss/linear_ce.py``): under an active plan the vocab-parallel
+  ``lse/pick`` shard_map runs per-shard and combines with the identical
+  psums the fused-CE training loss uses; without a plan a chunked
+  ``lax.scan`` computes logits one sequence chunk at a time — the full
+  ``[B, S, V]`` logit tensor never materializes on either path;
+* right-padding is EXACT by construction: attention is causal, so pad
+  columns after a row's last real token cannot influence any valid
+  position, and pad labels are ``IGNORE_INDEX`` (pinned).
+
+Batch convention (:func:`make_sequence_batch`): ``input_ids [B, S]`` padded
+right, ``labels [B, S]`` holding the NEXT-token target at every completion
+position (``labels[b, i] = seq[i + 1]`` when ``i + 1`` is a completion
+token) and ``IGNORE_INDEX`` over prompt/pad positions — the same
+pre-shifted-labels convention the SFT datasets use (``datasets/utils.py``).
+``completion_logprobs`` then returns ``log p(labels[b, i] | seq[:i + 1])``
+per position, ``0.0`` where masked.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from automodel_tpu.loss.masked_ce import IGNORE_INDEX
+
+__all__ = [
+    "IGNORE_INDEX",
+    "build_logprob_fn",
+    "completion_logprobs",
+    "make_sequence_batch",
+    "token_nll",
+]
+
+
+def _chunked_token_nll(hidden: jnp.ndarray, kernel: jnp.ndarray,
+                       labels: jnp.ndarray, chunk_len: int) -> jnp.ndarray:
+    """Per-token ``lse - picked`` via a sequence-chunk scan: logits exist
+    one ``[B, C, V]`` chunk at a time and are rematerialized in the
+    backward (``jax.checkpoint``), exactly the FusedLinearCrossEntropy
+    memory strategy — but returning the per-token values instead of their
+    sum."""
+    B, S, H = hidden.shape
+    C = min(chunk_len, S)
+    n_chunks = -(-S // C)
+    pad = n_chunks * C - S
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)),
+                         constant_values=IGNORE_INDEX)
+    hs = hidden.reshape(B, n_chunks, C, H).swapaxes(0, 1)
+    lb = labels.reshape(B, n_chunks, C).swapaxes(0, 1)
+    kern = kernel.astype(hidden.dtype)
+
+    @jax.checkpoint
+    def chunk_nll(h, l):
+        logits = (h @ kern).astype(jnp.float32)      # [B, C, V] — transient
+        valid = l != IGNORE_INDEX
+        safe = jnp.where(valid, l, 0)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, safe[..., None], -1).squeeze(-1)
+        return jnp.where(valid, lse - picked, 0.0)
+
+    def body(_, args):
+        h, l = args
+        return None, chunk_nll(h, l)
+
+    _, toks = lax.scan(body, None, (hs, lb))          # [n, B, C]
+    toks = toks.swapaxes(0, 1).reshape(B, n_chunks * C)
+    return toks[:, :S]
+
+
+def token_nll(hidden: jnp.ndarray, kernel: jnp.ndarray, labels: jnp.ndarray,
+              chunk_len: int = 256) -> jnp.ndarray:
+    """Per-token negative log-likelihood ``[B, S]`` (``lse - picked``,
+    ``0.0`` where ``labels == IGNORE_INDEX``), differentiable.
+
+    The dispatch MIRRORS ``loss/linear_ce.FusedLinearCrossEntropy``: when
+    the Pallas ``linear_ce`` rung is available (TPU, aligned shapes) and a
+    sharding context is active, the fused-CE vocab-parallel ``lse/pick``
+    shard_map runs — the identical per-shard compute + psum combine the
+    train step's fused loss lowers to; everywhere else the chunked scan
+    runs over the global arrays and GSPMD inserts exactly the collectives
+    it inserts for the training loss's chunked path.  Matching the loss's
+    own dispatch per environment is what keeps the logprob pass's
+    collective census a subset of the train forward's (tier-1 pinned)."""
+    from automodel_tpu.distributed.shardings import current_sharding
+
+    sh = current_sharding()
+    if sh is not None:
+        from automodel_tpu.ops.kernel_lib import registry as kernel_registry
+
+        B, S, H = hidden.shape
+        spec = kernel_registry.resolve(
+            "linear_ce.pallas",
+            {"kind": "linear_ce", "t": B * S, "h": H,
+             "v": kernel.shape[1], "bwd_mode": "pallas"})
+        if spec.name == "linear_ce.pallas":
+            from automodel_tpu.loss.linear_ce import _sharded_lse_pick
+
+            mesh, rules = sh
+            return _sharded_lse_pick(hidden, kernel, labels, mesh, rules,
+                                     "pallas")
+    return _chunked_token_nll(hidden, kernel, labels, chunk_len)
+
+
+def completion_logprobs(model, params, batch: Dict[str, Any],
+                        chunk_len: int = 256) -> jnp.ndarray:
+    """``log p(labels | input_ids)`` per token: ``[B, S]`` float32, ``0.0``
+    at every ``IGNORE_INDEX`` position.
+
+    Runs the model's TRAIN forward (``return_hidden=True`` — the fused-CE
+    routing, same collectives) and the chunked/sharded lse-pick; the full
+    logit tensor never materializes.  ``batch`` may carry ``position_ids``
+    / ``segment_ids`` / ``attention_mask`` like any train microbatch."""
+    kwargs = {k: batch[k]
+              for k in ("position_ids", "segment_ids", "attention_mask")
+              if batch.get(k) is not None}
+    out = model(params, batch["input_ids"], return_hidden=True, **kwargs)
+    nll = token_nll(out["hidden_states"], out["lm_head_kernel"],
+                    batch["labels"], chunk_len)
+    return -nll
+
+
+def build_logprob_fn(model, plan=None, chunk_len: int = 256):
+    """Jitted sharding-preserving logprob pass ``fn(params, batch) ->
+    [B, S]``.
+
+    With a :class:`~automodel_tpu.distributed.shardings.ParallelPlan` the
+    trace runs inside the plan's ``sharding_context`` (the train step's
+    exact activation-constraint rules) and params are consumed at the
+    plan's shardings — the frozen reference policy and the live policy
+    share ONE compiled entry because their shardings match.  Output is
+    replicated (small: ``[B, S]`` f32).
+    """
+    if plan is not None:
+        from automodel_tpu.distributed.shardings import sharding_context
+
+        ctx = functools.partial(
+            sharding_context, plan.mesh, plan.rules,
+            cp_layout=getattr(plan, "cp_layout", "contiguous"))
+    else:
+        ctx = contextlib.nullcontext
+
+    def fn(params, batch):
+        with ctx():
+            return completion_logprobs(model, params, batch, chunk_len)
+
+    if plan is not None:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        return jax.jit(fn, in_shardings=(plan.param_sharding, None),
+                       out_shardings=NamedSharding(plan.mesh, P()))
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# Host-side batch building
+# ---------------------------------------------------------------------------
+def make_sequence_batch(sequences: Sequence[Sequence[int]],
+                        prompt_lens: Sequence[int], *,
+                        pad_id: int = 0,
+                        pad_to: Optional[int] = None,
+                        ) -> Dict[str, np.ndarray]:
+    """``{prompt + completion}`` token lists -> the logprob batch.
+
+    * ``input_ids [B, S]`` right-padded with ``pad_id``;
+    * ``labels [B, S]``: ``labels[b, i] = seq[i + 1]`` at every position
+      whose NEXT token is a completion token (``i + 1 >= prompt_len``),
+      ``IGNORE_INDEX`` over prompt-interior and pad positions — so a
+      sequence of P prompt + C completion tokens yields exactly C
+      supervised positions (the last prompt token predicts the first
+      completion token, causal convention);
+    * ``position_ids [B, S]`` plain arange (right-padding keeps true
+      positions; causality makes pad columns inert — see module
+      docstring).
+
+    ``pad_to`` pins a STATIC sequence length (rollout batches must bucket
+    to one shape or every training step would recompile —
+    ``assert_compiles_once`` is tier-1-pinned across rollout→train
+    cycles); sequences longer than ``pad_to`` raise.
+    """
+    if not sequences:
+        raise ValueError("make_sequence_batch: no sequences")
+    if len(sequences) != len(prompt_lens):
+        raise ValueError(
+            f"make_sequence_batch: {len(sequences)} sequences vs "
+            f"{len(prompt_lens)} prompt lengths")
+    B = len(sequences)
+    longest = max(len(s) for s in sequences)
+    S = pad_to if pad_to is not None else longest
+    if longest > S:
+        raise ValueError(
+            f"make_sequence_batch: longest sequence ({longest} tokens) "
+            f"exceeds pad_to={S} — raise rl.max_prompt_len / "
+            "rl.max_new_tokens so the static shape covers every rollout")
+    ids = np.full((B, S), pad_id, np.int32)
+    labels = np.full((B, S), IGNORE_INDEX, np.int32)
+    for b, (seq, plen) in enumerate(zip(sequences, prompt_lens)):
+        seq = [int(t) for t in seq]
+        plen = int(plen)
+        if not 0 < plen <= len(seq):
+            raise ValueError(
+                f"make_sequence_batch: row {b} prompt_len={plen} outside "
+                f"(0, len={len(seq)}]")
+        ids[b, :len(seq)] = seq
+        for i in range(max(plen - 1, 0), len(seq) - 1):
+            labels[b, i] = seq[i + 1]
+    pos = np.broadcast_to(np.arange(S, dtype=np.int32), (B, S)).copy()
+    return {"input_ids": ids, "labels": labels, "position_ids": pos}

@@ -1,0 +1,107 @@
+"""GRPO and DPO objectives — pure ``jnp``, independently testable.
+
+GRPO (DeepSeekMath / DeepSeek-R1 family): per prompt, ``G`` sampled
+completions form one group; the advantage of completion ``i`` is its
+reward group-normalized (``(r_i - mean_G) / (std_G + eps)``) — no value
+network.  The policy term is the PPO-style clipped importance-weighted
+gradient against the BEHAVIOR logprobs (the policy at rollout time; with
+one optimizer step per rollout the first-step ratio is exactly 1 and the
+objective reduces to plain ``-A * log p``), plus an optional KL penalty to
+a FROZEN reference policy using the k3 estimator
+``exp(ref - pi) - (ref - pi) - 1`` (non-negative, unbiased, low-variance).
+
+DPO (Rafailov et al.): offline preference pairs; the loss is
+``-log sigmoid(beta * ((pi_c - ref_c) - (pi_r - ref_r)))`` over SEQUENCE
+log-likelihood sums.  Both objectives consume per-token logprobs from the
+sharding-preserving pass (``post_training/logprobs.py``), which is the
+whole point: neither ever needs an unsharded model or a dense logit
+tensor.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# The ``post_training.algorithm`` config domain (registered in
+# ``config/loader._enum_fields``; lint rule L002 enforces registration).
+PT_ALGORITHMS = ("grpo", "dpo")
+
+# Degenerate-group guard: a group whose rewards are all identical carries
+# no signal; the normalizer's epsilon keeps its advantages at exactly 0
+# instead of amplifying float noise into a gradient.
+ADVANTAGE_EPS = 1e-4
+
+
+def group_normalized_advantages(rewards: jnp.ndarray, group_size: int,
+                                eps: float = ADVANTAGE_EPS) -> jnp.ndarray:
+    """``[N]`` rewards (groups CONTIGUOUS: rollout ``i`` of prompt ``p`` at
+    index ``p * G + i``) -> ``[N]`` group-normalized advantages."""
+    r = jnp.asarray(rewards, jnp.float32)
+    if r.ndim != 1:
+        raise ValueError(f"rewards must be [N], got shape {r.shape}")
+    if r.shape[0] % group_size:
+        raise ValueError(
+            f"rewards length {r.shape[0]} is not divisible by "
+            f"group_size={group_size}")
+    g = r.reshape(-1, group_size)
+    mean = jnp.mean(g, axis=1, keepdims=True)
+    std = jnp.std(g, axis=1, keepdims=True)
+    return ((g - mean) / (std + eps)).reshape(-1)
+
+
+def grpo_token_objective(
+    policy_logps: jnp.ndarray,      # [B, S] live policy (differentiated)
+    behavior_logps: jnp.ndarray,    # [B, S] rollout-time policy (data)
+    ref_logps: jnp.ndarray,         # [B, S] frozen reference (data)
+    advantages: jnp.ndarray,        # [B]
+    mask: jnp.ndarray,              # [B, S] 1.0 at completion tokens
+    *,
+    kl_coef: float = 0.0,
+    clip_eps: float = 0.2,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Summed GRPO objective over completion tokens + diagnostic sums.
+
+    Returns ``(loss_sum, aux)`` where ``aux`` holds ``pg_sum`` /
+    ``kl_sum`` / ``ratio_sum`` (all masked sums — the caller divides by
+    its token count, matching the framework's sum-then-normalize loss
+    convention).  ``behavior_logps`` / ``ref_logps`` arrive as batch DATA
+    (already detached); only ``policy_logps`` carries gradient.
+    """
+    mask = mask.astype(jnp.float32)
+    adv = jnp.asarray(advantages, jnp.float32)[:, None]
+    ratio = jnp.exp(policy_logps - behavior_logps)
+    unclipped = ratio * adv
+    clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps) * adv
+    pg = -jnp.minimum(unclipped, clipped)
+    pg_sum = jnp.sum(pg * mask)
+    aux = {"pg_sum": pg_sum, "ratio_sum": jnp.sum(ratio * mask)}
+    loss_sum = pg_sum
+    if kl_coef:
+        # k3 estimator of KL(pi || ref): >= 0, zero iff pi == ref
+        delta = ref_logps - policy_logps
+        kl = jnp.exp(delta) - delta - 1.0
+        kl_sum = jnp.sum(kl * mask)
+        loss_sum = loss_sum + kl_coef * kl_sum
+        aux["kl_sum"] = kl_sum
+    else:
+        aux["kl_sum"] = jnp.float32(0.0)
+    return loss_sum, aux
+
+
+def dpo_losses(
+    policy_chosen: jnp.ndarray,     # [B] sequence logprob sums (live)
+    policy_rejected: jnp.ndarray,   # [B]
+    ref_chosen: jnp.ndarray,        # [B] frozen reference (data)
+    ref_rejected: jnp.ndarray,      # [B]
+    *,
+    beta: float = 0.1,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-pair DPO losses ``[B]`` and the implicit reward margins
+    ``[B]`` (``beta * ((pi_c - ref_c) - (pi_r - ref_r))``; a positive
+    margin means the policy already prefers the chosen answer)."""
+    margins = beta * ((policy_chosen - ref_chosen)
+                      - (policy_rejected - ref_rejected))
+    return -jax.nn.log_sigmoid(margins), margins

@@ -1,0 +1,249 @@
+"""Jitted GRPO / DPO optimizer steps on the train step's plumbing.
+
+These are siblings of ``training/train_step.py::build_train_step`` — same
+plan-driven shardings (``state_partition_specs`` over the optax state,
+params consumed AND produced at the plan's NamedShardings, donation of
+params/opt state), same grad-dtype discipline (opt state initialized
+against grad-dtype params so the first update never flips dtypes and
+recompiles — the PR-6 lesson), same fused ``metrics["_packed"]`` single-
+transfer metrics contract.  The difference is the loss: instead of masked
+CE over a dataloader batch, the loss differentiates the sharding-
+preserving logprob pass (``post_training/logprobs.py``) through the GRPO /
+DPO objectives (``post_training/losses.py``).
+
+Batch contracts (all arrays static-shape — rollout batches bucket to one
+``[B, S]`` via ``make_sequence_batch(pad_to=...)``, so each step function
+compiles exactly once):
+
+* GRPO: ``input_ids``/``labels``/``position_ids [B, S]``,
+  ``behavior_logps``/``ref_logps [B, S]`` (data — already detached),
+  ``advantages [B]``.
+* DPO: ``chosen_*`` and ``rejected_*`` id/label/position triples
+  ``[B, S]`` plus ``ref_chosen_logp``/``ref_rejected_logp [B]``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from automodel_tpu.loss.masked_ce import IGNORE_INDEX
+from automodel_tpu.post_training.logprobs import completion_logprobs
+from automodel_tpu.post_training.losses import (
+    dpo_losses,
+    grpo_token_objective,
+)
+
+# Fused metrics buffers (the train step's ``_PACKED_KEYS`` contract: pack
+# and unpack sites iterate ONE list each, single f32 d2h transfer).
+GRPO_PACKED_KEYS = ("loss", "pg_loss", "kl", "grad_norm",
+                    "num_completion_tokens", "mean_ratio")
+DPO_PACKED_KEYS = ("loss", "accuracy", "margin", "grad_norm", "num_pairs")
+
+
+@dataclasses.dataclass
+class PostTrainStepFns:
+    """One jitted optimizer step + the opt-state plumbing it was built
+    with (mirrors ``TrainStepFns`` for the post-training recipes)."""
+
+    step: Callable          # (params, opt_state, batch) -> (p, o, metrics)
+    init_opt_state: Callable
+    opt_state_sharding: Any
+    packed_keys: Tuple[str, ...]
+
+    def unpack_metrics(self, metrics: Dict[str, Any]) -> Dict[str, float]:
+        """ONE device fetch of the fused buffer -> python floats."""
+        vals = jax.device_get(metrics["_packed"])
+        return {k: float(v) for k, v in zip(self.packed_keys, vals)}
+
+
+def _plan_ctx(plan):
+    if plan is None:
+        return contextlib.nullcontext
+    from automodel_tpu.distributed.shardings import sharding_context
+
+    return functools.partial(
+        sharding_context, plan.mesh, plan.rules,
+        cp_layout=getattr(plan, "cp_layout", "contiguous"))
+
+
+def _init_opt_fn(tx, grad_dtype):
+    def init_opt(params):
+        # grad-dtype init (see train_step.init_opt): tx.update consumes
+        # grad_dtype gradients, so initializing moments from raw bf16
+        # params would flip opt-state dtypes on update 1 — a guaranteed
+        # second XLA compile.
+        as_grad = jax.tree.map(
+            lambda p: (p.astype(grad_dtype)
+                       if jnp.issubdtype(p.dtype, jnp.floating) else p),
+            params)
+        return tx.init(as_grad)
+
+    return init_opt
+
+
+def _finish_update(tx, params, opt_state, loss_grads, grad_dtype):
+    grads = jax.tree.map(lambda g: g.astype(grad_dtype), loss_grads)
+    grad_norm = optax.global_norm(grads)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, grad_norm
+
+
+def _jit_step(step, init_opt, model, plan, tx,
+              packed_keys) -> PostTrainStepFns:
+    if plan is None:
+        return PostTrainStepFns(
+            jax.jit(step, donate_argnums=(0, 1)), jax.jit(init_opt),
+            None, packed_keys)
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from automodel_tpu.distributed.shardings import (
+        state_partition_specs,
+        to_named_shardings,
+    )
+
+    mesh = plan.mesh
+    abs_params = model.abstract_params()
+    abs_opt = jax.eval_shape(tx.init, abs_params)
+    opt_specs = state_partition_specs(abs_opt, abs_params, plan.param_specs)
+    opt_sharding = to_named_shardings(mesh, opt_specs)
+    rep = NamedSharding(mesh, P())
+    return PostTrainStepFns(
+        jax.jit(step,
+                in_shardings=(plan.param_sharding, opt_sharding, None),
+                out_shardings=(plan.param_sharding, opt_sharding, rep),
+                donate_argnums=(0, 1)),
+        jax.jit(init_opt, out_shardings=opt_sharding),
+        opt_sharding, packed_keys)
+
+
+def _pack(metrics: Dict[str, jnp.ndarray],
+          keys: Tuple[str, ...]) -> Dict[str, jnp.ndarray]:
+    metrics["_packed"] = jnp.stack(
+        [metrics[k].astype(jnp.float32) for k in keys])
+    return metrics
+
+
+def build_grpo_step(
+    model,
+    tx: optax.GradientTransformation,
+    plan=None,
+    *,
+    kl_coef: float = 0.0,
+    clip_eps: float = 0.2,
+    grad_dtype: Any = jnp.float32,
+    chunk_len: int = 256,
+) -> PostTrainStepFns:
+    """Jitted ``grpo_step(params, opt_state, batch)``.
+
+    One rollout batch is one optimizer step (GRPO's canonical on-policy
+    regime; grad accumulation over multiple rollout batches is the
+    recipe's job, not the step's).  The loss differentiates the logprob
+    pass under the plan's sharding context — the forward's collectives are
+    the train step's, census-pinned."""
+    ctx = _plan_ctx(plan)
+
+    def grpo_step(params, opt_state, batch):
+        mask = (batch["labels"] != IGNORE_INDEX).astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+
+        def loss_of(p):
+            with ctx():
+                policy_lp = completion_logprobs(model, p, batch, chunk_len)
+            # On-policy single-update GRPO (the recipes): behavior == the
+            # live policy, so the detached policy logprobs ARE the
+            # behavior terms — omitting "behavior_logps" from the batch
+            # saves a whole logprob forward per step with identical math
+            # (exp(lp - stop_grad(lp)) has value 1 and gradient d(lp)).
+            # Off-policy callers (multi-epoch reuse) pass them explicitly.
+            behavior = batch.get("behavior_logps")
+            if behavior is None:
+                behavior = jax.lax.stop_gradient(policy_lp)
+            ref = batch.get("ref_logps")
+            if ref is None:
+                ref = behavior    # reference-free: the k3 term is 0
+            loss_sum, aux = grpo_token_objective(
+                policy_lp, behavior, ref,
+                batch["advantages"], mask,
+                kl_coef=kl_coef, clip_eps=clip_eps)
+            return loss_sum / denom, aux
+
+        (loss, aux), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+        params, opt_state, grad_norm = _finish_update(
+            tx, params, opt_state, grads, grad_dtype)
+        metrics = {
+            "loss": loss,
+            "pg_loss": aux["pg_sum"] / denom,
+            "kl": aux["kl_sum"] / denom,
+            "grad_norm": grad_norm,
+            "num_completion_tokens": jnp.sum(mask),
+            "mean_ratio": aux["ratio_sum"] / denom,
+        }
+        return params, opt_state, _pack(metrics, GRPO_PACKED_KEYS)
+
+    return _jit_step(grpo_step, _init_opt_fn(tx, grad_dtype), model, plan,
+                     tx, GRPO_PACKED_KEYS)
+
+
+def build_dpo_step(
+    model,
+    tx: optax.GradientTransformation,
+    plan=None,
+    *,
+    beta: float = 0.1,
+    grad_dtype: Any = jnp.float32,
+    chunk_len: int = 256,
+) -> PostTrainStepFns:
+    """Jitted ``dpo_step(params, opt_state, batch)`` — DPO is GRPO's
+    offline sibling: the same logprob machinery runs over the chosen and
+    rejected halves of each preference pair, the frozen-reference terms
+    arrive as batch data (computed once per batch by the recipe through
+    the SAME jitted logprob fn), and the update plumbing is shared."""
+    ctx = _plan_ctx(plan)
+
+    def dpo_step(params, opt_state, batch):
+        B = batch["chosen_input_ids"].shape[0]
+
+        def loss_of(p):
+            with ctx():
+                c_lp = completion_logprobs(
+                    model, p,
+                    {"input_ids": batch["chosen_input_ids"],
+                     "labels": batch["chosen_labels"],
+                     "position_ids": batch.get("chosen_position_ids")},
+                    chunk_len)
+                r_lp = completion_logprobs(
+                    model, p,
+                    {"input_ids": batch["rejected_input_ids"],
+                     "labels": batch["rejected_labels"],
+                     "position_ids": batch.get("rejected_position_ids")},
+                    chunk_len)
+            losses, margins = dpo_losses(
+                jnp.sum(c_lp, axis=-1), jnp.sum(r_lp, axis=-1),
+                batch["ref_chosen_logp"], batch["ref_rejected_logp"],
+                beta=beta)
+            return jnp.mean(losses), margins
+
+        (loss, margins), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params)
+        params, opt_state, grad_norm = _finish_update(
+            tx, params, opt_state, grads, grad_dtype)
+        metrics = {
+            "loss": loss,
+            "accuracy": jnp.mean((margins > 0).astype(jnp.float32)),
+            "margin": jnp.mean(margins),
+            "grad_norm": grad_norm,
+            "num_pairs": jnp.float32(B),
+        }
+        return params, opt_state, _pack(metrics, DPO_PACKED_KEYS)
+
+    return _jit_step(dpo_step, _init_opt_fn(tx, grad_dtype), model, plan,
+                     tx, DPO_PACKED_KEYS)

@@ -1,0 +1,142 @@
+"""The DPO post-training recipe: offline preference pairs, same logprob
+machinery as GRPO.
+
+DPO is the offline sibling: no rollouts, no rewards — a dataset of
+``(prompt, chosen, rejected)`` pairs drives the loss
+``-log sigmoid(beta * ((pi_c - ref_c) - (pi_r - ref_r)))`` over sequence
+log-likelihoods.  All four terms come from the SAME sharding-preserving
+per-token logprob pass the GRPO recipe uses (``post_training/
+logprobs.py``): the reference terms are computed once per batch against a
+frozen device copy of the initial policy (through the identical compiled
+program — params share shardings), and the jitted DPO step differentiates
+the policy terms.
+
+Config schema (``examples/rl/tiny_llama_dpo_mock.yaml``): ``dataset``
+rows must carry ``prompt_ids`` / ``chosen_ids`` / ``rejected_ids`` (the
+mock pairs builder ``datasets/llm/mock.build_preference_pairs_dataset``
+or any HF preference set mapped to that shape).  ``rl.rollout_batch_size``
+is the pairs-per-step batch; ``rl.beta`` the DPO temperature.  RL state
+(the pair cursor, counters) round-trips through the async checkpoint
+protocol exactly like GRPO's.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import numpy as np
+
+from automodel_tpu.config.arg_parser import parse_args_and_load_config
+from automodel_tpu.post_training.base import PostTrainingRecipeBase
+from automodel_tpu.post_training.logprobs import make_sequence_batch
+from automodel_tpu.post_training.steps import build_dpo_step
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_DPO_BETA = 0.1
+
+
+class DPORecipeForCausalLM(PostTrainingRecipeBase):
+    algorithm = "dpo"
+    uses_engine = False   # offline: no rollouts, no KV pools
+
+    def _needs_reference(self) -> bool:
+        return True   # the DPO loss is defined against the reference
+
+    def _build_step_fns(self):
+        from automodel_tpu.config.loader import normalize_null_spelling
+
+        beta = normalize_null_spelling(self.cfg.get("rl.beta"))
+        self.beta = float(beta) if beta is not None else DEFAULT_DPO_BETA
+        return build_dpo_step(self.model, self.optimizer, plan=self.plan,
+                              beta=self.beta)
+
+    # -- pairs source ------------------------------------------------------
+    def _setup_data(self) -> None:
+        ds_cfg = self.cfg.get("dataset")
+        if ds_cfg is None:
+            raise ValueError("DPO needs a dataset: section of preference "
+                             "pairs (prompt_ids/chosen_ids/rejected_ids)")
+        dataset = ds_cfg.instantiate()
+        rc = self.rollout_config
+        self._pairs = []
+        for row in dataset:
+            if not all(k in row for k in
+                       ("prompt_ids", "chosen_ids", "rejected_ids")):
+                raise ValueError(
+                    "DPO dataset rows must carry prompt_ids/chosen_ids/"
+                    f"rejected_ids; got keys {sorted(row)}")
+            p = [int(t) for t in row["prompt_ids"]][: rc.max_prompt_len]
+            c = [int(t) for t in row["chosen_ids"]][: rc.max_new_tokens]
+            r = [int(t) for t in row["rejected_ids"]][: rc.max_new_tokens]
+            if p and c and r:
+                self._pairs.append((p, c, r))
+        if len(self._pairs) < rc.rollout_batch_size:
+            raise ValueError(
+                f"dataset yields {len(self._pairs)} usable pairs < "
+                f"rl.rollout_batch_size={rc.rollout_batch_size}")
+
+    def _next_pairs(self):
+        rc = self.rollout_config
+        cursor = self.rl_state.data_cursor
+        out = [self._pairs[(cursor + i) % len(self._pairs)]
+               for i in range(rc.rollout_batch_size)]
+        self.rl_state.data_cursor = cursor + rc.rollout_batch_size
+        return out
+
+    def _pair_batch(self, pairs) -> Dict[str, np.ndarray]:
+        rc = self.rollout_config
+        S = rc.sequence_length
+        chosen = make_sequence_batch(
+            [p + c for p, c, _ in pairs], [len(p) for p, _, _ in pairs],
+            pad_id=rc.pad_token_id, pad_to=S)
+        rejected = make_sequence_batch(
+            [p + r for p, _, r in pairs], [len(p) for p, _, _ in pairs],
+            pad_id=rc.pad_token_id, pad_to=S)
+        return {
+            "chosen_input_ids": chosen["input_ids"],
+            "chosen_labels": chosen["labels"],
+            "chosen_position_ids": chosen["position_ids"],
+            "rejected_input_ids": rejected["input_ids"],
+            "rejected_labels": rejected["labels"],
+            "rejected_position_ids": rejected["position_ids"],
+        }
+
+    # -- one DPO step ------------------------------------------------------
+    def _one_step(self, step: int) -> Dict[str, float]:
+        batch = self._pair_batch(self._next_pairs())
+        with self.timers.record("logprob"):
+            ref_c = self.logprob_fn(
+                self._ref_params,
+                {"input_ids": batch["chosen_input_ids"],
+                 "labels": batch["chosen_labels"],
+                 "position_ids": batch["chosen_position_ids"]})
+            ref_r = self.logprob_fn(
+                self._ref_params,
+                {"input_ids": batch["rejected_input_ids"],
+                 "labels": batch["rejected_labels"],
+                 "position_ids": batch["rejected_position_ids"]})
+        import jax.numpy as jnp
+
+        batch["ref_chosen_logp"] = jnp.sum(ref_c, axis=-1)
+        batch["ref_rejected_logp"] = jnp.sum(ref_r, axis=-1)
+        with self.timers.record("train"):
+            self.params, self.opt_state, device_metrics = self.step_fns.step(
+                self.params, self.opt_state, batch)
+        metrics = self.step_fns.unpack_metrics(device_metrics)
+        self.rl_state.rollouts += 1   # one pair batch consumed
+        return metrics
+
+
+def main(config_path: Optional[str] = None, argv=None):
+    logging.basicConfig(level=logging.INFO)
+    cfg = parse_args_and_load_config(argv, default_config=config_path)
+    recipe = DPORecipeForCausalLM(cfg)
+    recipe.setup()
+    recipe.run_post_training_loop()
+    return recipe
+
+
+if __name__ == "__main__":
+    main()

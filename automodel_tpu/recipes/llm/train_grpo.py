@@ -1,0 +1,140 @@
+"""The GRPO post-training recipe: rollout → advantage → policy gradient,
+interleaved on ONE mesh.
+
+Each optimizer step is one full GRPO cycle:
+
+1. **weight handoff** — the live training params move into the decode
+   engine (``DecodeEngine.update_params``; device-to-device, bitwise —
+   the ``rollout_weight_sync`` drilled seam);
+2. **rollout** — ``rollout_batch_size`` prompts x ``group_size`` sampled
+   completions through the PR-12 continuous-batching engine
+   (``rollout_engine_step`` drilled: a mid-generation failure aborts the
+   in-flight requests and the next rollout is clean);
+3. **reward + advantage** — ``rl.reward_source`` scores each completion
+   (``reward_fn`` drilled), advantages are group-normalized;
+4. **logprobs** — the FROZEN reference policy gets one sharding-
+   preserving pass (skipped when ``rl.kl_coef`` is null: the
+   reference-free option); the behavior terms are the live policy's own
+   logprobs, derived in-place (``stop_gradient``) inside the jitted step
+   — on-policy single-update GRPO never pays a separate behavior
+   forward;
+5. **policy gradient** — the jitted GRPO step (clipped PG + k3 KL) shares
+   the train step's optimizer/sharding/metrics plumbing.
+
+Config schema (``examples/rl/tiny_llama_grpo_mock.yaml``): ``model`` /
+``distributed`` / ``optimizer`` / ``checkpoint`` / ``dataset`` (the prompt
+source) as in SFT, plus ``post_training:`` (algorithm/max_steps/cadences),
+``rl:`` (group_size, rollout_batch_size, sampling, reward, kl_coef) and
+``serving:`` (the engine's knobs).  RL state (reward EMA, rollout
+counters, the prompt cursor) checkpoints through the PR-1/5 async
+protocol and round-trips exactly.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from automodel_tpu.config.arg_parser import parse_args_and_load_config
+from automodel_tpu.post_training.base import PostTrainingRecipeBase
+from automodel_tpu.post_training.logprobs import make_sequence_batch
+from automodel_tpu.post_training.losses import group_normalized_advantages
+from automodel_tpu.post_training.rollout import compute_rewards
+from automodel_tpu.post_training.steps import build_grpo_step
+
+logger = logging.getLogger(__name__)
+
+
+class GRPORecipeForCausalLM(PostTrainingRecipeBase):
+    algorithm = "grpo"
+
+    def _needs_reference(self) -> bool:
+        return self.rollout_config.kl_coef is not None
+
+    def _build_step_fns(self):
+        rc = self.rollout_config
+        return build_grpo_step(
+            self.model, self.optimizer, plan=self.plan,
+            kl_coef=float(rc.kl_coef or 0.0), clip_eps=rc.clip_eps)
+
+    # -- prompt source -----------------------------------------------------
+    def _setup_data(self) -> None:
+        """Prompts come from a plain dataset (the SFT mock/hellaswag
+        schemas): each row's leading tokens, capped at
+        ``rl.max_prompt_len``.  The cursor lives in RL state, so resume
+        continues the SAME prompt stream."""
+        ds_cfg = self.cfg.get("dataset")
+        if ds_cfg is None:
+            raise ValueError("GRPO needs a dataset: section (the prompt "
+                             "source)")
+        dataset = ds_cfg.instantiate()
+        rc = self.rollout_config
+        self._prompts: List[List[int]] = []
+        for row in dataset:
+            ids = [int(t) for t in row["input_ids"]]
+            cut = min(rc.max_prompt_len, max(1, len(ids) // 2))
+            if ids[:cut]:
+                self._prompts.append(ids[:cut])
+        if len(self._prompts) < rc.rollout_batch_size:
+            raise ValueError(
+                f"dataset yields {len(self._prompts)} usable prompts < "
+                f"rl.rollout_batch_size={rc.rollout_batch_size}")
+
+    def _next_prompts(self) -> List[List[int]]:
+        rc = self.rollout_config
+        out = []
+        cursor = self.rl_state.data_cursor
+        for _ in range(rc.rollout_batch_size):
+            out.append(self._prompts[cursor % len(self._prompts)])
+            cursor += 1
+        self.rl_state.data_cursor = cursor
+        return out
+
+    # -- one GRPO cycle ----------------------------------------------------
+    def _one_step(self, step: int) -> Dict[str, float]:
+        rc = self.rollout_config
+        with self.timers.record("rollout"):
+            rb = self.rollout_worker.generate(self._next_prompts(),
+                                              params=self.params)
+            compute_rewards(rb, rc)
+        batch = make_sequence_batch(
+            rb.sequences, rb.prompt_lens, pad_id=rc.pad_token_id,
+            pad_to=rc.sequence_length)
+        if self._ref_params is not None:
+            with self.timers.record("logprob"):
+                # only the FROZEN reference needs its own pass; the
+                # behavior terms are the live policy's own logprobs, which
+                # the jitted step derives in-place (stop_gradient) — one
+                # whole forward per step saved vs computing them here
+                batch["ref_logps"] = self.logprob_fn(self._ref_params,
+                                                     batch)
+        batch["advantages"] = group_normalized_advantages(
+            np.asarray(rb.rewards), rc.group_size)
+        with self.timers.record("train"):
+            self.params, self.opt_state, device_metrics = self.step_fns.step(
+                self.params, self.opt_state, batch)
+        metrics = self.step_fns.unpack_metrics(device_metrics)
+        mean_reward = float(np.mean(rb.rewards))
+        self.rl_state.note_rollout(mean_reward, rb.stats["tokens"])
+        metrics.update({
+            "reward_mean": mean_reward,
+            "reward_ema": float(self.rl_state.reward_ema),
+            "rollout_tok_s": rb.stats["tokens_per_s"],
+            "sync_ms": rb.stats["sync_s"] * 1e3,
+        })
+        return metrics
+
+
+def main(config_path: Optional[str] = None, argv=None):
+    logging.basicConfig(level=logging.INFO)
+    cfg = parse_args_and_load_config(argv, default_config=config_path)
+    recipe = GRPORecipeForCausalLM(cfg)
+    recipe.setup()
+    recipe.run_post_training_loop()
+    return recipe
+
+
+if __name__ == "__main__":
+    main()

@@ -210,9 +210,19 @@ class DecodeEngine:
     def __init__(self, model, params, config: Optional[ServingConfig] = None,
                  generation: Optional[GenerationConfig] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 timers=None):
+                 timers=None, param_sharding=None, sample_seed: int = 0):
         self.model = model
+        # Decode-plan placement (the weight-handoff contract, see
+        # :meth:`update_params`): a pytree of shardings pins where the
+        # engine's OWN COPIES of the params live; None adopts arrays as
+        # handed (no training loop in play — tests, tools/serve.py).
+        self.param_sharding = param_sharding
+        self._sync_copy = None
+        if param_sharding is not None:
+            params = self._copy_into_decode_plan(params)
         self.params = params
+        self._param_structs = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
         self.config = config or ServingConfig()
         self.generation = generation or GenerationConfig()
         self.clock = clock
@@ -248,7 +258,8 @@ class DecodeEngine:
         self.rejections: List[RequestRejected] = []
         self._rids = itertools.count()
         self._steps: Dict[int, Any] = {}       # width -> jitted step
-        self._sample_key = jax.random.key(0)
+        self._sample_key = jax.random.key(sample_seed)
+        self.weight_syncs = 0
         self.steps_run = 0
         self.decode_steps = 0
         self.mixed_steps = 0
@@ -269,6 +280,63 @@ class DecodeEngine:
                 donate_argnums=(1,))
             self._steps[width] = fn
         return fn
+
+    # -- weight handoff (post-training rollouts on one mesh) ---------------
+    def _copy_into_decode_plan(self, params):
+        """A genuine device-side COPY of ``params`` at the decode plan's
+        shardings.  A plain ``device_put`` into an already-matching
+        sharding is a no-op ALIAS — and the post-training optimizer steps
+        DONATE the live tree, so an aliased engine would hold deleted
+        buffers the moment training stepped.  The jitted copy (compiled
+        once) keeps the transfer on-fabric — no host round-trip — while
+        giving the engine buffers it owns outright."""
+        if self._sync_copy is None:
+            self._sync_copy = jax.jit(
+                lambda t: jax.tree.map(jnp.copy, t),
+                out_shardings=self.param_sharding)
+        return self._sync_copy(params)
+
+    def update_params(self, params) -> None:
+        """Adopt LIVE training params — the explicit weight-handoff API
+        the post-training rollout layer drives (``post_training/
+        rollout.py``; ``docs/guides/post_training.md`` "The weight-handoff
+        contract").
+
+        * **Device-to-device**: when the engine was built with a
+          ``param_sharding`` pytree (its decode plan), the incoming tree —
+          typically sharded per the TRAIN plan — is COPIED into it by a
+          jitted device-side copy: an async on-fabric transfer, never a
+          host round-trip, and the engine owns the result (the training
+          loop donates its params every optimizer step, so the engine can
+          never alias them).  With no decode plan the arrays are adopted
+          as handed — correct only when nothing donates them.
+        * **Compile-stable**: the pytree structure and every leaf's
+          shape/dtype must match what the engine was built with —
+          anything else would silently invalidate the compiled step
+          entries, so it raises instead.
+        * The handoff itself never touches request state: in-flight
+          sequences keep decoding under the NEW weights (recompute-style
+          preemption semantics already tolerate that; rollout drivers
+          sync only between generations).
+        """
+        structs = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+        try:
+            match = jax.tree.all(jax.tree.map(
+                lambda a, b: a == b, structs, self._param_structs))
+        except ValueError as e:
+            raise ValueError(
+                "update_params: incoming pytree structure does not match "
+                f"the engine's params ({e})") from None
+        if not match:
+            raise ValueError(
+                "update_params: incoming leaf shapes/dtypes do not match "
+                "the engine's params — the compiled decode steps would be "
+                "invalid; build a new engine for a different model")
+        if self.param_sharding is not None:
+            params = self._copy_into_decode_plan(params)
+        self.params = params
+        self.weight_syncs += 1
 
     # -- request intake ----------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
@@ -586,6 +654,7 @@ class DecodeEngine:
             "rejected": self.scheduler.rejected,
             "pinned": self.scheduler.pins,
             "watchdog_recoveries": self.watchdog_recoveries,
+            "weight_syncs": self.weight_syncs,
             "kv_pool_bytes": pool_bytes(self.pools),
             "kv_blocks_peak": self.allocator.peak_used,
             "kv_blocks_free": self.allocator.free_blocks,

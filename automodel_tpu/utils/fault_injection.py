@@ -142,6 +142,31 @@ Serving-engine points (see ``serving/scheduler.py`` / ``serving/engine.py``):
                       replays the admitted requests from their last
                       computed token (pinned; greedy output stays
                       token-identical through the recovery).
+
+Post-training rollout points (see ``post_training/rollout.py``):
+
+    rollout_weight_sync
+                      in ``RolloutWorker.sync_weights``, at the top of
+                      the live-params handoff into the decode engine —
+                      a failed device-to-device transfer.  Contract: the
+                      engine keeps its PREVIOUS weights, nothing was
+                      submitted, a typed RolloutError surfaces, training
+                      state is untouched and the next rollout re-syncs
+                      cleanly.
+    rollout_engine_step
+                      in the rollout drive loop, before each engine step
+                      — a device-step failure / runtime cancellation
+                      mid-generation.  Contract: every in-flight request
+                      of the rollout is ABORTED through the serving abort
+                      path (block tables reclaimed immediately —
+                      ``allocator.all_free`` afterwards), the typed
+                      RolloutError surfaces, training state is untouched,
+                      and the next rollout starts clean.
+    reward_fn         in ``post_training/rollout.compute_rewards`` — an
+                      external reward service failing.  Contract: the
+                      completed rollout is DISCARDED typed (its blocks
+                      were already freed at finish); training state is
+                      untouched.
 """
 
 from __future__ import annotations
@@ -181,6 +206,9 @@ KNOWN_FAULT_POINTS = frozenset({
     "serve_deadline",
     "serve_shed",
     "serve_watchdog_stall",
+    "rollout_weight_sync",
+    "rollout_engine_step",
+    "reward_fn",
 })
 
 

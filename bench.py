@@ -198,6 +198,20 @@ SECONDARY = {
     # cost/benefit number.  ``BENCH_PP_MICROBATCHES`` sets k (default 4);
     # ``BENCH_PP_SCHEDULE`` pins 1f1b|gpipe.
     "pipeline": [],
+    # Post-training legs (docs/guides/post_training.md; BENCH_RL=0 skips
+    # both):
+    # ``grpo`` — _grpo_secondary_main: full GRPO cycles (weight handoff ->
+    # engine rollout -> logprobs -> policy-gradient step) on the tiny mock
+    # recipe; reports rollout tokens/s through the engine as tps plus the
+    # train-vs-rollout wall split (rollout_wall_frac / train_wall_frac /
+    # logprob_wall_frac) — the number that says which side of the
+    # interleave to optimize next.
+    "grpo": [],
+    # ``rollout_sync`` — _rollout_sync_secondary_main: weight-sync latency
+    # (ms per update, mean over a burst) of DecodeEngine.update_params —
+    # the device-to-device train-plan -> decode-plan handoff; tps is the
+    # mean sync ms, sync_mb the params moved per update.
+    "rollout_sync": [],
     # Checkpoint-stall leg: handled by _ckpt_secondary_main — times a
     # training window containing saves under checkpoint.async_save true vs
     # false through the real recipe save path.  Reports the mean per-save
@@ -929,6 +943,96 @@ def _ckpt_secondary_main() -> None:
                       "vs_baseline": round(async_stall / sync_stall, 4)}))
 
 
+def _grpo_secondary_main() -> None:
+    """Child process: the GRPO interleave on one mesh — rollout tokens/s
+    through the engine + the train-vs-rollout wall split.
+
+    Drives the real recipe (``recipes/llm/train_grpo.py`` on the mock
+    YAML, checkpointing off) for a few warmed cycles and reads the
+    recipe's own rollout/logprob/train timers.  Absolute tok/s on a CPU
+    dev host is not chip-meaningful; the leg exists so the interleave's
+    wall split stays visible run over run (a rollout_wall_frac drifting
+    toward 1.0 says the decode engine — not the train step — is the next
+    thing to optimize).  ``BENCH_RL=0`` skips."""
+    if os.environ.get("BENCH_RL", "1") == "0":
+        raise SystemExit("BENCH_RL=0: post-training legs skipped")
+    from automodel_tpu.config.loader import load_yaml_config
+    from automodel_tpu.recipes.llm.train_grpo import GRPORecipeForCausalLM
+
+    cfg = load_yaml_config(
+        os.path.join(ROOT, "examples", "rl", "tiny_llama_grpo_mock.yaml"))
+    cfg.set_by_dotted("checkpoint.enabled", False)
+    cfg.set_by_dotted("online_eval.enabled", False)
+    steps, warmup = (3, 2) if SMALL else (8, 3)
+    recipe = GRPORecipeForCausalLM(cfg).setup()
+    for s in range(1, warmup + 1):
+        recipe._one_step(s)
+        recipe.rl_state.step = s
+    recipe.timers.get_elapsed(reset=True)
+    tokens0 = recipe.rl_state.tokens_generated
+    syncs = []
+    t0 = time.perf_counter()
+    for s in range(warmup + 1, warmup + steps + 1):
+        recipe._one_step(s)
+        recipe.rl_state.step = s
+        syncs.append(recipe.rollout_worker.last_sync_s)
+    wall = time.perf_counter() - t0
+    elapsed = recipe.timers.get_elapsed(reset=True)  # window totals (s)
+    tokens = recipe.rl_state.tokens_generated - tokens0
+    rollout_s = elapsed.get("rollout", 0.0)
+    train_s = elapsed.get("train", 0.0)
+    logprob_s = elapsed.get("logprob", 0.0)
+    recipe.teardown()
+    print(json.dumps({
+        "tps": round(tokens / max(rollout_s, 1e-9), 1),
+        "rollout_wall_frac": round(rollout_s / max(wall, 1e-9), 4),
+        "train_wall_frac": round(train_s / max(wall, 1e-9), 4),
+        "logprob_wall_frac": round(logprob_s / max(wall, 1e-9), 4),
+        "grpo_sync_ms": round(1e3 * float(np.mean(syncs)), 3),
+    }))
+
+
+def _rollout_sync_secondary_main() -> None:
+    """Child process: weight-sync latency of the handoff API.
+
+    Times ``DecodeEngine.update_params`` over a burst of syncs between
+    two distinct param trees (so every update genuinely moves bytes),
+    blocking on the placed arrays each round — the per-update latency a
+    GRPO step pays before every rollout.  ``BENCH_RL=0`` skips."""
+    if os.environ.get("BENCH_RL", "1") == "0":
+        raise SystemExit("BENCH_RL=0: post-training legs skipped")
+    import jax
+
+    from automodel_tpu.generation import GenerationConfig
+    from automodel_tpu.serving import DecodeEngine, ServingConfig
+
+    model = _tiny_quant_llama()
+    params_a = model.init(jax.random.key(0))
+    params_b = jax.tree.map(lambda x: x * 1.0001, params_a)
+    eng = DecodeEngine(
+        model, params_a,
+        ServingConfig(kv_block_size=16, max_num_seqs=4, max_model_len=64,
+                      prefill_chunk=16),
+        generation=GenerationConfig(max_new_tokens=4),
+        # a decode plan makes every update a REAL device-side copy (the
+        # engine-owns-its-buffers handoff contract) — without it the
+        # update is a host-side rebind and the leg would time nothing
+        param_sharding=jax.tree.map(lambda x: x.sharding, params_a))
+    nbytes = sum(x.nbytes for x in jax.tree.leaves(params_a))
+    n = 8 if SMALL else 32
+    eng.update_params(params_b)
+    jax.block_until_ready(eng.params)
+    t0 = time.perf_counter()
+    for i in range(n):
+        eng.update_params(params_a if i % 2 else params_b)
+        jax.block_until_ready(eng.params)
+    per_sync_ms = 1e3 * (time.perf_counter() - t0) / n
+    print(json.dumps({
+        "tps": round(per_sync_ms, 3),
+        "sync_mb": round(nbytes / 1024**2, 2),
+    }))
+
+
 def _secondary_main(name: str) -> None:
     """Child process: one secondary config, prints {"tps": ...}."""
     if name == "long_context_16k_cp":
@@ -951,6 +1055,10 @@ def _secondary_main(name: str) -> None:
         return _serve_decode_secondary_main()
     if name == "serve":
         return _serve_trace_secondary_main()
+    if name == "grpo":
+        return _grpo_secondary_main()
+    if name == "rollout_sync":
+        return _rollout_sync_secondary_main()
     steps, warmup = (4, 2) if SMALL else (8, 3)
     if name == "unpacked" and not SMALL:
         # two length buckets (1024/1152) after the 128-alignment: warm both

@@ -100,6 +100,15 @@ def test_l002_registered_and_non_enum_constants_clean():
     assert _lint('FOO_MODES = ("solo",)\n') == []
 
 
+def test_l002_post_training_suffixes():
+    # ALGORITHMS/SOURCES joined the suffix convention with the
+    # post_training.algorithm / rl.reward_source fields (PR 15)
+    assert _lint('PT_ALGORITHMS = ("grpo", "dpo")\n') == []
+    assert _lint('REWARD_SOURCES = ("length_target", "callable")\n') == []
+    assert _rules(_lint('FOO_ALGORITHMS = ("a", "b")\n')) == ["L002"]
+    assert _rules(_lint('BAR_SOURCES = ("a", "b")\n')) == ["L002"]
+
+
 # ---------------------------------------------------------------------------
 # L003 — nondeterminism / wall-clock under jit
 # ---------------------------------------------------------------------------
