@@ -68,6 +68,7 @@ from automodel_tpu.utils.fault_injection import fault_point
 logger = logging.getLogger(__name__)
 
 CATALOG_FILE_PREFIX = "replica_catalog"
+LIVE_CATALOG_FILE_PREFIX = "live_catalog"
 
 
 class ReplicaGeneration:
@@ -113,9 +114,11 @@ _lock = threading.Lock()
 
 
 def reset() -> None:
-    """Forget every replica (tests / process teardown)."""
+    """Forget every replica — checkpoint generations AND live-params
+    stores (tests / process teardown)."""
     with _lock:
         _STORES.clear()
+        _LIVE_STORES.clear()
 
 
 def drop_slice(slice_id: int, devices=None) -> None:
@@ -401,6 +404,187 @@ def read_catalogs(checkpoint_dir: str) -> List[Dict[str, Any]]:
         except (OSError, ValueError) as e:
             logger.warning("unreadable replica catalog %s: %s", name, e)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Live decode params (the serving fleet's grow-back warm-up transport)
+# ---------------------------------------------------------------------------
+# The checkpoint transport above carries COMMITTED generations keyed by
+# step.  A serving-fleet admission (serving/fleet.py) needs the peer's
+# params as they are RIGHT NOW — which may never correspond to any
+# checkpoint (post-training rollout pushes live weights between saves) —
+# so live stores are keyed by (replica_id, weight-sync version) instead
+# of (slice, step), but the bytes ride the SAME serialize/sha256/catalog
+# protocol: ``serialize_tree`` to push, ``_rebuild_tree`` (digest-
+# verified, ``ckpt_replica_restore``-drillable) to fetch.
+
+
+class LiveParamsEntry:
+    """One replica's advertised live decode params: the shard map plus the
+    ``weight_syncs`` version it was serialized at — a fetch pinned to a
+    version can detect that the peer synced weights mid-admission."""
+
+    def __init__(self, replica_id: int, version: int,
+                 shards: Dict[str, Tuple[str, bytes, Any,
+                                         Tuple[int, ...]]]):
+        self.replica_id = int(replica_id)
+        self.version = int(version)
+        self.shards = shards
+
+    @property
+    def nbytes(self) -> int:
+        return sum(len(s[1]) for s in self.shards.values())
+
+
+# replica_id -> LiveParamsEntry, same lock discipline as _STORES (a fleet
+# admission may run off-thread from the traffic loop in a real deployment)
+_LIVE_STORES: Dict[int, LiveParamsEntry] = {}
+
+
+def push_live_params(*, replica_id: int, params: Any, version: int = 0,
+                     catalog_dir: Optional[str] = None) -> LiveParamsEntry:
+    """Advertise one replica's CURRENT decode params for fleet warm-up.
+    ``params`` must already be host-side (numpy-convertible — the caller
+    does the one ``device_get``); memory is bounded to one generation per
+    replica (a re-push drops the previous bytes first).  The catalog
+    advertisement mirrors the checkpoint protocol: KV key
+    ``fleet_live/catalog/r<replica_id>`` plus an optional
+    ``live_catalog.r<replica_id>.json`` file mirror."""
+    shards = serialize_tree(params)
+    entry = LiveParamsEntry(replica_id, version, shards)
+    with _lock:
+        _LIVE_STORES[entry.replica_id] = entry
+    _advertise_live(entry, catalog_dir)
+    logger.info(
+        "replica %d live params advertised (version %d, %d shard(s), "
+        "%.1f MB)", entry.replica_id, entry.version, len(shards),
+        entry.nbytes / 1e6)
+    return entry
+
+
+def fetch_live_params(*, abstract: Any, replica_id: Optional[int] = None,
+                      version: Optional[int] = None) -> Optional[Any]:
+    """Digest-verified fetch of a live-params advertisement: a numpy
+    pytree matching ``abstract``, or None when the admission must abort
+    (no store, version moved, any shard fails its sha256 or shape/dtype —
+    same degrade-to-typed-failure contract as ``restore_from_peers``;
+    the ``ckpt_replica_restore`` drill corrupts this path too)."""
+    with _lock:
+        if replica_id is not None:
+            entries = ([_LIVE_STORES[int(replica_id)]]
+                       if int(replica_id) in _LIVE_STORES else [])
+        else:
+            entries = sorted(_LIVE_STORES.values(),
+                             key=lambda e: (-e.version, e.replica_id))
+    if not entries:
+        logger.warning(
+            "no live-params advertisement%s — fleet admission falls back "
+            "to its typed failure path",
+            f" for replica {replica_id}" if replica_id is not None else "")
+        return None
+    entry = entries[0]
+    if version is not None and entry.version != int(version):
+        logger.warning(
+            "live params of replica %d are version %d, fetch pinned "
+            "version %d — peer synced weights mid-admission; aborting "
+            "this warm-up", entry.replica_id, entry.version, version)
+        return None
+    try:
+        tree = _rebuild_tree(abstract, entry.shards)
+    except Exception as e:
+        logger.warning(
+            "live params of replica %d (version %d) failed verification "
+            "mid-fetch (%s) — fleet admission aborts, typed",
+            entry.replica_id, entry.version, e)
+        return None
+    logger.info(
+        "fetched replica %d's live params (version %d, %d shard(s), "
+        "digest-verified)", entry.replica_id, entry.version,
+        len(entry.shards))
+    return tree
+
+
+def drop_live_params(replica_id: int,
+                     catalog_dir: Optional[str] = None) -> bool:
+    """Replica teardown/loss: forget its live params AND retract the
+    advertisement (KV + file mirror) — the PR-11 rule, applied to the
+    fleet: a stale catalog must never serve a dead replica's params.
+    True iff a store was actually dropped."""
+    with _lock:
+        entry = _LIVE_STORES.pop(int(replica_id), None)
+    _retract_live_advertisement(int(replica_id), catalog_dir)
+    if entry is not None:
+        logger.info("replica %d live params dropped (version %d)",
+                    entry.replica_id, entry.version)
+    return entry is not None
+
+
+def live_stores_snapshot() -> Dict[int, Tuple[int, int]]:
+    """``{replica_id: (version, n_shards)}`` — test/operator introspection
+    mirroring ``stores_snapshot``."""
+    with _lock:
+        return {r: (e.version, len(e.shards))
+                for r, e in _LIVE_STORES.items()}
+
+
+def _advertise_live(entry: LiveParamsEntry,
+                    catalog_dir: Optional[str]) -> None:
+    """Best-effort live-params catalog advertisement — same two surfaces
+    as ``_advertise``, keyed by replica instead of process."""
+    from automodel_tpu.utils.dist_utils import _kv_client, kv_set_overwrite
+
+    client = _kv_client()
+    if client is not None:
+        try:
+            kv_set_overwrite(
+                client, f"fleet_live/catalog/r{entry.replica_id}",
+                json.dumps({"version": entry.version,
+                            "n_shards": len(entry.shards)}))
+        except Exception as e:  # pragma: no cover - live-pool only
+            logger.warning("live-params KV advertise failed: %s", e)
+    if catalog_dir:
+        path = os.path.join(
+            catalog_dir,
+            f"{LIVE_CATALOG_FILE_PREFIX}.r{entry.replica_id}.json")
+        try:
+            os.makedirs(catalog_dir, exist_ok=True)
+            catalog = {
+                "replica": entry.replica_id,
+                "version": entry.version,
+                "shards": {k: {"sha256": v[0], "bytes": len(v[1]),
+                               "dtype": str(np.dtype(v[2])),
+                               "shape": list(v[3])}
+                           for k, v in entry.shards.items()},
+            }
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(catalog, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.warning("live-params catalog mirror %s failed: %s",
+                           path, e)
+
+
+def _retract_live_advertisement(replica_id: int,
+                                catalog_dir: Optional[str]) -> None:
+    """Remove a replica's live-params advertisement (KV + file mirror) —
+    best-effort, like ``_retract_advertisement``."""
+    from automodel_tpu.utils.dist_utils import _kv_client
+
+    client = _kv_client()
+    if client is not None:
+        try:
+            client.key_value_delete(f"fleet_live/catalog/r{replica_id}")
+        except Exception:  # pragma: no cover - best-effort
+            pass
+    if catalog_dir:
+        path = os.path.join(
+            catalog_dir,
+            f"{LIVE_CATALOG_FILE_PREFIX}.r{replica_id}.json")
+        try:
+            os.remove(path)
+        except OSError:
+            pass
 
 
 # ---------------------------------------------------------------------------

@@ -365,6 +365,7 @@ def _enum_fields():
     from automodel_tpu.ops.zigzag import CP_LAYOUTS
     from automodel_tpu.post_training.losses import PT_ALGORITHMS
     from automodel_tpu.post_training.rollout import REWARD_SOURCES
+    from automodel_tpu.serving.fleet import ROUTER_POLICIES
     from automodel_tpu.serving.kv_cache import KV_CACHE_DTYPES
     from automodel_tpu.serving.scheduler import (
         SCHEDULER_POLICIES,
@@ -381,6 +382,7 @@ def _enum_fields():
         "serving.kv_cache_dtype": KV_CACHE_DTYPES,
         "serving.scheduler_policy": SCHEDULER_POLICIES,
         "serving.shed_policy": SHED_POLICIES,
+        "serving.router_policy": ROUTER_POLICIES,
         "pipeline.schedule": PP_SCHEDULES,
         "post_training.algorithm": PT_ALGORITHMS,
         "rl.reward_source": REWARD_SOURCES,
@@ -412,6 +414,11 @@ _BOOL_FIELDS = ("checkpoint.async_save", "checkpoint.replicate_to_peers")
 _POSITIVE_INT_FIELDS = ("pipeline.pp_size", "pipeline.num_microbatches",
                         "serving.max_waiting", "serving.max_preemptions",
                         "serving.sjf_aging_steps",
+                        # elastic fleet geometry (a typo'd replica count
+                        # must fail at load, not as an index error in the
+                        # router)
+                        "serving.replicas",
+                        "serving.fleet_probation_polls",
                         # post-training rollout geometry (a typo'd group
                         # size must fail at load, not as a reshape error in
                         # the advantage normalizer)

@@ -9,6 +9,9 @@ Layout::
                     deadlines/TTLs, admission control, the pin breaker
       engine.py     static-shape jitted steps + the host decode loop,
                     watchdog recovery + graceful drain
+      fleet.py      elastic replica fleet: routing, fleet-level shed,
+                    replica loss -> cross-replica replay, grow-back from
+                    live peer params
       eval.py       online-eval consumer (greedy scoring via the engine)
 
 The paged attention kernels live on the PR-7 substrate in
@@ -19,6 +22,10 @@ from automodel_tpu.serving.engine import (          # noqa: F401
     DecodeEngine,
     ServingConfig,
     build_serving_config,
+)
+from automodel_tpu.serving.fleet import (           # noqa: F401
+    ROUTER_POLICIES,
+    FleetRouter,
 )
 from automodel_tpu.serving.kv_cache import (        # noqa: F401
     KV_CACHE_DTYPES,

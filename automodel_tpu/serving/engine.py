@@ -117,6 +117,10 @@ class ServingConfig:
     sjf_aging_steps: Optional[int] = None    # None -> default (32)
     watchdog_s: Optional[float] = None       # None -> watchdog disabled
     drain_grace_s: Optional[float] = None    # None -> unbounded drain
+    # -- elastic fleet (docs/guides/serving.md "Elastic fleet") ------------
+    replicas: Optional[int] = None           # None -> 1 (single engine)
+    router_policy: Optional[str] = None      # None -> round_robin
+    fleet_probation_polls: Optional[int] = None   # None -> default (3)
 
     def __post_init__(self):
         for field in ("kv_block_size", "max_num_seqs", "max_model_len",
@@ -131,7 +135,8 @@ class ServingConfig:
                 f"got {self.num_kv_blocks!r}")
         from automodel_tpu.config.loader import normalize_null_spelling
 
-        for field in ("max_waiting", "max_preemptions", "sjf_aging_steps"):
+        for field in ("max_waiting", "max_preemptions", "sjf_aging_steps",
+                      "replicas", "fleet_probation_polls"):
             v = normalize_null_spelling(getattr(self, field))
             setattr(self, field, v)
             if v is None:
@@ -156,6 +161,15 @@ class ServingConfig:
             normalize_scheduler_policy(self.scheduler_policy))
         self.shed_policy = validate_shed_policy(
             normalize_shed_policy(self.shed_policy))
+        # lazy: fleet.py imports this module, so its enum validators are
+        # pulled in here at validation time only (no import cycle)
+        from automodel_tpu.serving.fleet import (
+            normalize_router_policy,
+            validate_router_policy,
+        )
+
+        self.router_policy = validate_router_policy(
+            normalize_router_policy(self.router_policy))
 
     @property
     def blocks_per_seq(self) -> int:
@@ -366,10 +380,47 @@ class DecodeEngine:
             deadline_s=deadline_s, max_queue_s=max_queue_s)
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        rejected = self.scheduler.add(req)   # ValueError = caller bug only
-        self.requests[rid] = req
-        self.rejections.extend(rejected)
+        self.submit_request(req)
         return rid
+
+    def submit_request(self, req) -> list:
+        """Admit an externally-built :class:`Request` (the fleet router
+        owns the rid space and builds requests itself — see
+        ``serving/fleet.py``).  Same admission path as :meth:`submit`:
+        the scheduler may shed it (typed, recorded in ``rejections``),
+        never raise.  Returns the :class:`RequestRejected` outcomes this
+        admission produced (possibly shedding OTHER queued rows)."""
+        rejected = self.scheduler.add(req)   # ValueError = caller bug only
+        self.requests[req.rid] = req
+        self.rejections.extend(rejected)
+        return rejected
+
+    def adopt_for_replay(self, req) -> None:
+        """Adopt an admitted request harvested from a LOST fleet replica:
+        parks it pinned/WAITING with ``num_computed`` reset, so the
+        recompute replay re-prefills prompt + generated-so-far here and
+        greedy output stays token-identical across the engine move."""
+        self.scheduler.adopt_replay(req)
+        self.requests[req.rid] = req
+
+    def harvest_for_replay(self) -> list:
+        """Strip every unfinished request off this engine for replay
+        elsewhere (this engine's slice was declared lost).  Each row's
+        slot/blocks are released — the allocator ends ``all_free`` once
+        finished rows are accounted — and ``num_computed`` resets so the
+        adopting engine replays from scratch.  Rows keep their terminal
+        flags (``was_admitted``, pinned, tokens-so-far) and leave
+        ``self.requests`` entirely: the fleet decides where they land."""
+        harvested = []
+        for req in list(self.scheduler.active) + list(self.scheduler.waiting):
+            if req.finished:
+                continue
+            self.scheduler._release(req)
+            req.num_computed = 0
+            req.state = RequestState.WAITING
+            harvested.append(req)
+            self.requests.pop(req.rid, None)
+        return harvested
 
     def abort(self, rid: int) -> None:
         """Cancel a request anywhere in its lifecycle; its block table is

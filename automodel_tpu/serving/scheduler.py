@@ -394,6 +394,29 @@ class Scheduler:
         if req not in self.waiting:
             self.waiting.append(req)
 
+    def adopt_replay(self, req: Request) -> None:
+        """Adopt an admitted request harvested from ANOTHER scheduler
+        (fleet replica loss — ``serving/fleet.py``): same recompute-replay
+        parking as :meth:`requeue_for_replay`, but the row also gets THIS
+        scheduler's arrival/tick stamps so aging and step-relative
+        bookkeeping stay monotone.  ``submit_time`` is deliberately KEPT —
+        the fleet shares one clock, and a replayed request's deadline/TTL
+        budget is end-to-end, not per-engine.  The row arrives with no
+        slot/blocks (the dead engine's harvest already released them) and
+        stays ``was_admitted``+pinned, so shed/drain/TTL never discard it."""
+        if req.finished:
+            return
+        req.arrival = self._arrivals
+        self._arrivals += 1
+        req.submit_tick = self._ticks
+        req.slot = None
+        req.blocks = []
+        req.num_computed = 0
+        req.state = RequestState.WAITING
+        req.pinned = True
+        if req not in self.waiting:
+            self.waiting.append(req)
+
     @property
     def active(self) -> List[Request]:
         return [r for r in self.slots if r is not None]

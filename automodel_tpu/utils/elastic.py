@@ -101,6 +101,60 @@ class SliceReturnedError(RuntimeError):
                else ""))
 
 
+class ReplicaLostError(RuntimeError):
+    """Serving-side loss event: a decode replica's slice is gone.  Unlike
+    :class:`SliceLostError` this is ABSORBED, not raised — the
+    :class:`~automodel_tpu.serving.fleet.FleetRouter` routes around the
+    loss (harvest + cross-replica replay) and records this in its
+    ``events`` log, because serving traffic must keep flowing while a
+    training step may legitimately unwind and reconfigure."""
+
+    def __init__(self, replica_id: int, reason: str,
+                 detected_at_poll: int = -1):
+        self.replica_id = replica_id
+        self.reason = reason
+        self.detected_at_poll = detected_at_poll
+        super().__init__(
+            f"serving replica {replica_id} lost ({reason})"
+            + (f" at poll {detected_at_poll}" if detected_at_poll >= 0
+               else ""))
+
+
+class ReplicaReturnedError(RuntimeError):
+    """Serving-side grow-back event: a lost replica passed fleet probation
+    and was re-admitted, warmed from a live peer's decode params (the
+    digest-verified ``push_live_params`` -> ``engine.update_params()``
+    handoff).  Recorded in the fleet's ``events`` log — the serving
+    analogue of :class:`SliceReturnedError`."""
+
+    def __init__(self, replica_id: int, reason: str,
+                 detected_at_poll: int = -1):
+        self.replica_id = replica_id
+        self.reason = reason
+        self.detected_at_poll = detected_at_poll
+        super().__init__(
+            f"serving replica {replica_id} readmitted ({reason})"
+            + (f" at poll {detected_at_poll}" if detected_at_poll >= 0
+               else ""))
+
+
+class ReplicaAdmitError(RuntimeError):
+    """A grow-back admission FAILED (warm-up transport, digest mismatch,
+    relaunch handshake — drilled by the ``fleet_replica_admit`` fault
+    point).  Typed and recorded, never propagated: the fleet keeps
+    serving shrunk and the replica's probation restarts from zero."""
+
+    def __init__(self, replica_id: int, reason: str,
+                 detected_at_poll: int = -1):
+        self.replica_id = replica_id
+        self.reason = reason
+        self.detected_at_poll = detected_at_poll
+        super().__init__(
+            f"serving replica {replica_id} admission failed ({reason})"
+            + (f" at poll {detected_at_poll}" if detected_at_poll >= 0
+               else ""))
+
+
 @dataclasses.dataclass
 class ElasticConfig:
     """``elastic:`` YAML section.

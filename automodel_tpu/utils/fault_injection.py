@@ -143,6 +143,37 @@ Serving-engine points (see ``serving/scheduler.py`` / ``serving/engine.py``):
                       computed token (pinned; greedy output stays
                       token-identical through the recovery).
 
+Serving-fleet points (see ``serving/fleet.py``):
+
+    fleet_route       in ``FleetRouter._route``, before a placement
+                      decision is rendered — a router that cannot place
+                      the request (replica lookup / transport failure).
+                      Contract: a typed RequestRejected outcome (reason
+                      ``route(injected)``, state REJECTED, no engine ever
+                      saw the request), NEVER an exception out of
+                      ``submit`` — clients retry on the typed signal.
+    fleet_replica_loss
+                      in ``FleetRouter.poll_health`` — a replica's slice
+                      declared lost (the serving analogue of
+                      ``slice_loss``; AUTOMODEL_LOST_REPLICA picks the
+                      victim, default the highest-id live replica).
+                      Contract: survivors' traffic is untouched, the dead
+                      replica's live-params advertisement is retracted,
+                      its admitted requests replay on survivors greedy
+                      token-identical from their kept tokens, queued rows
+                      re-route (or shed typed at the fleet level), and
+                      EVERY allocator — dead replica included — ends
+                      ``all_free``.
+    fleet_replica_admit
+                      in ``FleetRouter._admit_replica``, at the top of a
+                      grow-back admission — the warm-up transport or
+                      relaunch handshake breaking mid-admission.
+                      Contract: a typed ReplicaAdmitError in the fleet's
+                      ``events`` log, the replica stays dead with its
+                      probation restarted, and the shrunk fleet keeps
+                      serving — never a crash, never a half-admitted
+                      replica receiving traffic.
+
 Post-training rollout points (see ``post_training/rollout.py``):
 
     rollout_weight_sync
@@ -206,6 +237,9 @@ KNOWN_FAULT_POINTS = frozenset({
     "serve_deadline",
     "serve_shed",
     "serve_watchdog_stall",
+    "fleet_route",
+    "fleet_replica_loss",
+    "fleet_replica_admit",
     "rollout_weight_sync",
     "rollout_engine_step",
     "reward_fn",

@@ -185,6 +185,19 @@ SECONDARY = {
     # the serving-under-fire numbers: shed_rate, expired_rate,
     # goodput_fraction and overload_p99_ms (p99 of admitted requests).
     "serve": [],
+    # ``elastic_serve`` — _elastic_serve_secondary_main: the serving
+    # analogue of the elastic drill (docs/guides/serving.md "Elastic
+    # fleet").  A seeded arrival trace through a 2-replica FleetRouter
+    # with a SCRIPTED lose-a-slice / heal-a-slice cycle mid-traffic
+    # (``fleet_replica_loss`` armed on a fixed health poll; the lost
+    # replica re-admits through probation + the digest-verified live
+    # peer-params warm-up).  Reports ``goodput_fraction`` (finished in
+    # deadline / all submitted — sheds and replays included) and
+    # ``admitted_p99_ms`` (p99 latency of admitted-and-completed
+    # requests, replayed rows included) plus fleet_replays /
+    # fleet_readmissions / recovery_s (loss detected -> replica healed).
+    # ``BENCH_ELASTIC_SERVE=0`` skips the leg (records null).
+    "elastic_serve": [],
     # Pipeline-parallel leg (docs/guides/distributed.md "Pipeline
     # parallelism"; BENCH_PP=0 skips): handled by _pipeline_secondary_main
     # on the multichip dryrun mesh (pp2 x dp2 x tp2 over 8 virtual CPU
@@ -866,6 +879,119 @@ def _serve_trace_secondary_main() -> None:
     }))
 
 
+def _elastic_serve_secondary_main() -> None:
+    """Child process: the elastic-serving fleet leg.
+
+    Drives a seeded arrival trace through a 2-replica FleetRouter while a
+    SCRIPTED loss/heal cycle runs mid-traffic: ``fleet_replica_loss`` is
+    armed on a fixed health poll (the drive loop polls once per step), the
+    dead replica's admitted requests replay on the survivor, and the lost
+    replica is marked returning so probation + the live-peer-params
+    admission heal the fleet while traffic keeps flowing.  The trace and
+    prompts are drawn host-side up front (L003).  Reported:
+    ``goodput_fraction`` — finished-within-deadline over ALL submitted
+    (sheds during the shrunk window and replayed rows included: the
+    number an elastic fleet exists to keep high) — and
+    ``admitted_p99_ms`` (p99 latency of admitted-and-completed requests;
+    replays pay their recompute inside it), plus fleet_replays /
+    fleet_readmissions / recovery_s (loss poll -> healed poll wall).
+    ``BENCH_ELASTIC_SERVE=0`` skips.
+    """
+    if os.environ.get("BENCH_ELASTIC_SERVE", "1") == "0":
+        raise SystemExit("BENCH_ELASTIC_SERVE=0: elastic_serve leg skipped")
+    from automodel_tpu.generation import GenerationConfig
+    from automodel_tpu.serving import FleetRouter, ServingConfig
+    from automodel_tpu.training.timers import serve_goodput_fraction
+    from automodel_tpu.utils import fault_injection as fi
+
+    model, params = _serve_model()
+    n_req, max_new, seqs = (8, 8, 4) if SMALL else (24, 16, 4)
+    rng = np.random.default_rng(0)
+    prompts = [[int(t) for t in rng.integers(1, 2000, int(n))]
+               for n in rng.integers(8, 25, n_req)]
+    fleet = FleetRouter(
+        model, params,
+        ServingConfig(kv_block_size=16, max_num_seqs=seqs,
+                      max_model_len=32 + max_new, prefill_chunk=32,
+                      replicas=2, max_waiting=2 * seqs,
+                      fleet_probation_polls=2),
+        generation=GenerationConfig(max_new_tokens=max_new))
+    for _ in range(2):             # warm every replica's widths off clock
+        fleet.submit(prompts[0])
+    fleet.run()
+    n_warm = len(fleet.requests)
+    probe0 = time.perf_counter()
+    fleet.submit(prompts[0])
+    fleet.run()
+    per_req = time.perf_counter() - probe0
+    n_warm = len(fleet.requests)   # probe rides in the warm bucket too
+    # deadline sized to absorb the grow-back admission stall: this drive
+    # loop is single-threaded, so the healed replica's warm-up compiles
+    # block traffic for ~1s on a dev host (a real deployment admits
+    # off-thread) — the goodput number should price sheds and replays,
+    # not that artifact
+    deadline_s = max(40.0 * per_req, 2.0)
+    arrivals = np.cumsum(rng.exponential(per_req / 2, size=n_req))
+
+    lose_at_poll = max(3, n_req // 4)
+    fi.configure_faults(f"fleet_replica_loss:{lose_at_poll}")
+    t0 = time.perf_counter()
+    t_loss = t_heal = None
+    submitted = 0
+    lat = {}
+    submit_wall = {}
+    try:
+        while submitted < n_req or fleet.has_work():
+            now = time.perf_counter() - t0
+            while submitted < n_req and arrivals[submitted] <= now:
+                rid = fleet.submit(prompts[submitted],
+                                   deadline_s=deadline_s)
+                submit_wall[rid] = now
+                submitted += 1
+            if submitted:          # health polls start with the traffic
+                fleet.poll_health(step=submitted)
+            if fleet.replica_losses and t_loss is None:
+                t_loss = time.perf_counter() - t0
+            if fleet.readmissions and t_heal is None:
+                t_heal = time.perf_counter() - t0
+            for rep in fleet.replicas:      # scripted heal: announce back
+                if not rep.alive:
+                    fleet.note_return(rep.replica_id)
+            for req in fleet.step():
+                if req.rid in submit_wall:
+                    lat[req.rid] = (time.perf_counter() - t0
+                                    - submit_wall[req.rid])
+            if not fleet.has_work() and submitted < n_req:
+                time.sleep(max(0.0, min(
+                    0.001, arrivals[submitted] - now)))
+        # the loss may land late: keep polling until grow-back completes
+        for extra in range(8):
+            if all(r.alive for r in fleet.replicas):
+                break
+            fleet.poll_health(step=n_req + extra)
+            if fleet.readmissions and t_heal is None:
+                t_heal = time.perf_counter() - t0
+    finally:
+        fi.reset_faults()
+    fleet.teardown()
+    outcomes = dict(fleet.outcome_counts())
+    outcomes["finished"] = outcomes.get("finished", n_warm) - n_warm
+    lat_ms = np.asarray(sorted(lat.values())) * 1e3
+    goodput = serve_goodput_fraction(
+        fleet.completed_in_deadline() - n_warm, outcomes)
+    print(json.dumps({
+        "tps": round(goodput, 4),
+        "goodput_fraction": round(goodput, 4),
+        "admitted_p99_ms": round(float(np.percentile(lat_ms, 99)), 2)
+        if len(lat_ms) else None,
+        "fleet_replays": fleet.replays,
+        "fleet_readmissions": fleet.readmissions,
+        "fleet_shed": fleet.fleet_rejected,
+        "recovery_s": round(t_heal - t_loss, 3)
+        if t_loss is not None and t_heal is not None else None,
+    }))
+
+
 def _ckpt_secondary_main() -> None:
     """Child process: the checkpoint-stall leg.
 
@@ -1055,6 +1181,8 @@ def _secondary_main(name: str) -> None:
         return _serve_decode_secondary_main()
     if name == "serve":
         return _serve_trace_secondary_main()
+    if name == "elastic_serve":
+        return _elastic_serve_secondary_main()
     if name == "grpo":
         return _grpo_secondary_main()
     if name == "rollout_sync":

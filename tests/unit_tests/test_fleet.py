@@ -1,0 +1,586 @@
+"""Elastic serving fleet: routing, fleet-level shed, replica loss with
+cross-replica replay, and grow-back from live peer params.
+
+The anchor is the FLEET DRILL (acceptance): seeded traffic across two
+replicas on a virtual clock with ``fleet_replica_loss`` armed — zero
+crashes, the lost replica's admitted requests finish on survivors greedy
+token-identical to ``generate()``, the shrunk fleet sheds typed
+(``fleet_full``) rather than wedging, the healed replica is re-admitted
+from a live peer's digest-verified params and serves new traffic, and
+every allocator (the dead replica's included) ends ``all_free``.
+
+``fleet_route`` and ``fleet_replica_admit`` are drilled alongside
+(typed rejection / typed ReplicaAdmitError, never a crash), and the
+coordinator classification rule is pinned: only the coordinator's own
+timeout verdict (``SliceLostError``) may shrink the fleet — any other
+RPC error propagates untouched.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.analysis.jaxpr_audit import assert_compiles_once
+from automodel_tpu.checkpoint import replication as rep
+from automodel_tpu.generation import GenerationConfig, generate
+from automodel_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from automodel_tpu.serving import (
+    FleetRouter,
+    RequestState,
+    Scheduler,
+    ServingConfig,
+)
+from automodel_tpu.serving.kv_cache import BlockAllocator
+from automodel_tpu.utils import fault_injection as fi
+from automodel_tpu.utils.elastic import (
+    ReplicaAdmitError,
+    ReplicaLostError,
+    ReplicaReturnedError,
+    SliceLostError,
+)
+
+CFG = LlamaConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128,
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    rope_theta=10000.0, tie_word_embeddings=True,
+    max_position_embeddings=128)
+
+LENS = [9, 6, 13, 5]
+MAX_NEW = 8
+
+
+class VirtualClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = LlamaForCausalLM(CFG, param_dtype=jnp.float32,
+                             compute_dtype=jnp.float32, remat=False)
+    params = model.init(jax.random.key(0))
+    leaves, td = jax.tree.flatten(params)
+    keys = jax.random.split(jax.random.key(5), len(leaves))
+    params = jax.tree.unflatten(td, [
+        l + 0.05 * jax.random.normal(k, l.shape, l.dtype)
+        for l, k in zip(leaves, keys)])
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(1)
+    S = max(LENS)
+    ids = np.zeros((len(LENS), S), np.int64)
+    for b, n in enumerate(LENS):
+        ids[b, :n] = rng.integers(1, 255, n)
+    return ids
+
+
+@pytest.fixture(scope="module")
+def dense_oracle(model_and_params, prompts):
+    model, params = model_and_params
+    return np.asarray(generate(
+        model, params, prompts, prompt_lens=np.asarray(LENS),
+        config=GenerationConfig(max_new_tokens=MAX_NEW)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_live_stores():
+    yield
+    rep.reset()
+
+
+def _cfg(**kw):
+    base = dict(kv_block_size=8, max_num_seqs=4, max_model_len=64,
+                prefill_chunk=8, replicas=2, fleet_probation_polls=2)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def _fleet(model_and_params, clock=None, coordinator=None, **kw):
+    model, params = model_and_params
+    kwargs = {} if clock is None else {"clock": clock}
+    return FleetRouter(model, params, _cfg(**kw),
+                       generation=GenerationConfig(max_new_tokens=MAX_NEW),
+                       coordinator=coordinator, **kwargs)
+
+
+def _submit_all(fleet, prompts, **kw):
+    return [fleet.submit(prompts[b, :LENS[b]], **kw)
+            for b in range(len(LENS))]
+
+
+def _assert_rows_match_oracle(fleet, rids, dense_oracle):
+    for b, rid in enumerate(rids):
+        req = fleet.requests[rid]
+        assert req.state is RequestState.FINISHED, (b, req.state)
+        np.testing.assert_array_equal(np.asarray(req.out_tokens),
+                                      dense_oracle[b])
+
+
+# ---------------------------------------------------------------------------
+# Routing policies + fleet-level shed
+# ---------------------------------------------------------------------------
+def test_round_robin_distributes_across_replicas(model_and_params, prompts):
+    fleet = _fleet(model_and_params)   # default policy: round_robin
+    _submit_all(fleet, prompts)
+    assert fleet.stats()["routed"] == {0: 2, 1: 2}
+
+
+def test_least_loaded_picks_emptier_replica(model_and_params, prompts):
+    fleet = _fleet(model_and_params, router_policy="least_loaded")
+    _submit_all(fleet, prompts)
+    # loads alternate 0,1,0,1 as each submission rebalances
+    assert fleet.stats()["routed"] == {0: 2, 1: 2}
+    # pile 2 more onto the fleet, then kill balance by hand: replica 1's
+    # queue drained => next submission must go there
+    fleet.replicas[1].engine.scheduler.waiting.clear()
+    fleet.submit(prompts[0, :LENS[0]])
+    assert fleet.replicas[1].routed == 3
+
+
+def test_by_deadline_splits_deadline_vs_besteffort(model_and_params,
+                                                   prompts):
+    fleet = _fleet(model_and_params, router_policy="by_deadline")
+    # skew load onto replica 0 first with best-effort (round-robin) rows
+    fleet.submit(prompts[0, :LENS[0]])              # rr -> replica 0
+    fleet.submit(prompts[1, :LENS[1]])              # rr -> replica 1
+    fleet.submit(prompts[2, :LENS[2]])              # rr -> replica 0
+    # a deadline-carrying request must take the least-loaded replica (1)
+    fleet.submit(prompts[3, :LENS[3]], deadline_s=5.0)
+    assert fleet.replicas[1].routed == 2
+
+
+def test_fleet_sheds_typed_when_every_replica_full(model_and_params,
+                                                   prompts):
+    fleet = _fleet(model_and_params, max_waiting=1)
+    r0 = fleet.submit(prompts[0, :LENS[0]])
+    r1 = fleet.submit(prompts[1, :LENS[1]])
+    # both replicas' waiting queues are at the bound: fleet-level shed
+    r2 = fleet.submit(prompts[2, :LENS[2]])
+    req = fleet.requests[r2]
+    assert req.state is RequestState.REJECTED
+    assert req.finish_reason == "fleet_full"
+    assert fleet.rejections[-1].rid == r2
+    assert fleet.rejections[-1].reason == "fleet_full"
+    assert fleet.fleet_rejected == 1
+    # the admitted rows are untouched
+    assert fleet.requests[r0].state is RequestState.WAITING
+    assert fleet.requests[r1].state is RequestState.WAITING
+
+
+def test_fleet_config_validation():
+    with pytest.raises(ValueError, match="router_policy"):
+        ServingConfig(router_policy="fastest")
+    with pytest.raises(ValueError, match="replicas"):
+        ServingConfig(replicas=0)
+    with pytest.raises(ValueError, match="fleet_probation_polls"):
+        ServingConfig(fleet_probation_polls=-1)
+    cfg = ServingConfig(replicas="null", router_policy="none",
+                        fleet_probation_polls=4)
+    assert cfg.replicas is None and cfg.router_policy is None
+    assert cfg.fleet_probation_polls == 4
+
+
+def test_fleet_knobs_validated_at_config_load(tmp_path):
+    from automodel_tpu.config.loader import load_yaml_config
+
+    cases = [
+        ("serving:\n  router_policy: fastest\n", "serving.router_policy"),
+        ("serving:\n  replicas: 0\n", "serving.replicas"),
+        ("serving:\n  fleet_probation_polls: 1.5\n",
+         "serving.fleet_probation_polls"),
+    ]
+    p = tmp_path / "bad.yaml"
+    for text, field in cases:
+        p.write_text(text)
+        with pytest.raises(ValueError, match=field.replace(".", r"\.")):
+            load_yaml_config(str(p))
+    p.write_text("serving:\n  router_policy: least_loaded\n"
+                 "  replicas: 3\n  fleet_probation_polls: 2\n")
+    cfg = load_yaml_config(str(p))
+    assert cfg.get("serving.router_policy") == "least_loaded"
+    assert cfg.get("serving.replicas") == 3
+
+
+def test_fleet_knobs_revalidated_after_cli_override():
+    from automodel_tpu.config.arg_parser import parse_args_and_load_config
+
+    yaml = "examples/serve/tiny_llama_serve.yaml"
+    cfg = parse_args_and_load_config(
+        ["--config", yaml, "--serving.router_policy", "by_deadline",
+         "--serving.replicas", "2"])
+    assert cfg.get("serving.router_policy") == "by_deadline"
+    assert cfg.get("serving.replicas") == 2
+    with pytest.raises(ValueError, match=r"serving\.router_policy"):
+        parse_args_and_load_config(
+            ["--config", yaml, "--serving.router_policy", "fastest"])
+    with pytest.raises(ValueError, match=r"serving\.replicas"):
+        parse_args_and_load_config(
+            ["--config", yaml, "--serving.replicas", "0"])
+
+
+# ---------------------------------------------------------------------------
+# Scheduler/engine seams
+# ---------------------------------------------------------------------------
+def test_adopt_replay_keeps_submit_time_and_restamps_arrival():
+    clock = VirtualClock()
+    a = Scheduler(BlockAllocator(64), max_num_seqs=2, prefill_chunk=4,
+                  block_size=4, max_model_len=64, clock=clock)
+    b = Scheduler(BlockAllocator(64), max_num_seqs=2, prefill_chunk=4,
+                  block_size=4, max_model_len=64, clock=clock)
+    from automodel_tpu.serving import Request
+
+    req = Request(rid=7, prompt=[1, 2, 3, 4], max_new_tokens=4,
+                  deadline_s=10.0)
+    a.add(req)
+    t_submit = req.submit_time
+    req.was_admitted = True
+    req.num_computed = 3
+    clock.advance(4.0)
+    a._release(req)
+    b.add(Request(rid=8, prompt=[1], max_new_tokens=1))   # bump arrivals
+    b.adopt_replay(req)
+    assert req.submit_time == t_submit        # deadline stays end-to-end
+    assert req.num_computed == 0              # recompute replay
+    assert req.pinned and req.state is RequestState.WAITING
+    assert req in b.waiting and req not in a.waiting
+    assert req.arrival == 1                   # B's arrival counter, not A's
+    # the end-to-end budget reflects the 4s already burned on A
+    assert req.remaining_budget(clock()) == pytest.approx(6.0)
+
+
+def test_harvest_for_replay_releases_every_block(model_and_params,
+                                                 prompts):
+    fleet = _fleet(model_and_params)
+    _submit_all(fleet, prompts)
+    for _ in range(3):
+        fleet.step()
+    victim = fleet.replicas[0]
+    assert not victim.engine.allocator.all_free    # mid-decode, blocks held
+    harvested = victim.engine.harvest_for_replay()
+    assert harvested and victim.engine.allocator.all_free
+    assert not victim.engine.requests              # rows left the engine
+    for req in harvested:
+        assert req.num_computed == 0 and req.blocks == []
+
+
+# ---------------------------------------------------------------------------
+# Fault drills
+# ---------------------------------------------------------------------------
+@pytest.mark.fault
+def test_fleet_route_fault_is_typed_rejection(model_and_params, prompts,
+                                              dense_oracle):
+    """An armed ``fleet_route`` produces a typed RequestRejected — never an
+    exception out of submit — and the fleet serves the next request."""
+    fleet = _fleet(model_and_params)
+    fi.configure_faults("fleet_route:1")
+    try:
+        r0 = fleet.submit(prompts[0, :LENS[0]])
+    finally:
+        fi.reset_faults()
+    req = fleet.requests[r0]
+    assert req.state is RequestState.REJECTED
+    assert req.finish_reason == "route(injected)"
+    assert fleet.rejections[-1].reason == "route(injected)"
+    r1 = fleet.submit(prompts[1, :LENS[1]])
+    fleet.run()
+    np.testing.assert_array_equal(
+        np.asarray(fleet.requests[r1].out_tokens), dense_oracle[1])
+    assert fleet.all_free()
+
+
+@pytest.mark.fault
+def test_cross_replica_replay_token_identity(model_and_params, prompts,
+                                             dense_oracle, monkeypatch):
+    """A request begun on replica 0 and finished on replica 1 after a
+    drilled ``fleet_replica_loss`` is greedy token-identical to an
+    uninterrupted ``generate()``."""
+    monkeypatch.setenv("AUTOMODEL_LOST_REPLICA", "0")
+    fleet = _fleet(model_and_params, router_policy="least_loaded")
+    rid = fleet.submit(prompts[0, :LENS[0]])       # least_loaded -> 0
+    for _ in range(4):                             # prefill + some decode
+        fleet.step()
+    req = fleet.requests[rid]
+    assert req.was_admitted and len(req.out_tokens) > 0
+    tokens_before = list(req.out_tokens)
+    fi.configure_faults("fleet_replica_loss:1")
+    try:
+        ev = fleet.poll_health(step=4)
+    finally:
+        fi.reset_faults()
+    assert isinstance(ev, ReplicaLostError) and ev.replica_id == 0
+    assert not fleet.replicas[0].alive
+    fleet.run()
+    assert req.state is RequestState.FINISHED
+    assert rid in fleet.replicas[1].engine.requests   # finished on B
+    # generated-so-far was kept, and the full output matches the oracle
+    assert list(req.out_tokens[:len(tokens_before)]) == tokens_before
+    np.testing.assert_array_equal(np.asarray(req.out_tokens),
+                                  dense_oracle[0])
+    assert fleet.all_free()
+
+
+@pytest.mark.fault
+def test_mid_chunked_prefill_loss_replays_token_identical(
+        model_and_params, prompts, dense_oracle, monkeypatch):
+    """Losing a replica while a request is mid-chunked-prefill (computed
+    part of its prompt, produced nothing) still replays token-identical:
+    the adopting engine re-prefills from scratch."""
+    monkeypatch.setenv("AUTOMODEL_LOST_REPLICA", "0")
+    fleet = _fleet(model_and_params, router_policy="least_loaded")
+    rid = fleet.submit(prompts[2, :LENS[2]])       # len 13 > chunk 8
+    fleet.step()                                   # one 8-token chunk
+    req = fleet.requests[rid]
+    assert req.was_admitted
+    assert 0 < req.num_computed < len(req.prompt)
+    assert not req.out_tokens
+    fi.configure_faults("fleet_replica_loss:1")
+    try:
+        fleet.poll_health(step=1)
+    finally:
+        fi.reset_faults()
+    fleet.run()
+    assert req.state is RequestState.FINISHED
+    np.testing.assert_array_equal(np.asarray(req.out_tokens),
+                                  dense_oracle[2])
+    assert fleet.all_free()
+
+
+@pytest.mark.fault
+def test_fleet_replica_admit_fault_keeps_serving_shrunk(
+        model_and_params, prompts, dense_oracle):
+    """An armed ``fleet_replica_admit`` aborts the grow-back typed (a
+    ReplicaAdmitError in the events log, probation restarted) and the
+    shrunk fleet keeps serving; a clean retry admits."""
+    fleet = _fleet(model_and_params)
+    fi.configure_faults("fleet_replica_loss:1")
+    try:
+        fleet.poll_health(step=0)
+    finally:
+        fi.reset_faults()
+    assert not fleet.replicas[1].alive
+    fleet.note_return(1)
+    fi.configure_faults("fleet_replica_admit:1")
+    try:
+        for p in range(1, 4):
+            fleet.poll_health(step=p)
+    finally:
+        fi.reset_faults()
+    assert not fleet.replicas[1].alive             # admit failed, typed
+    assert any(isinstance(e, ReplicaAdmitError) for e in fleet.events)
+    rids = _submit_all(fleet, prompts)             # shrunk fleet serves
+    fleet.run()
+    _assert_rows_match_oracle(fleet, rids, dense_oracle)
+    # clean retry: probation restarts from zero, then admission lands
+    fleet.note_return(1)
+    for p in range(4, 4 + fleet.probation_polls):
+        fleet.poll_health(step=p)
+    assert fleet.replicas[1].alive
+    assert any(isinstance(e, ReplicaReturnedError) for e in fleet.events)
+    assert fleet.all_free()
+
+
+@pytest.mark.fault
+def test_fleet_drill_loss_replay_shed_heal(model_and_params, prompts,
+                                           dense_oracle):
+    """THE FLEET DRILL (acceptance): seeded traffic across 2 replicas on a
+    virtual clock with ``fleet_replica_loss`` armed — zero crashes, the
+    lost replica's admitted requests finish on survivors token-identical,
+    the shrunk fleet sheds typed rather than wedging, the healed replica
+    re-admits from digest-verified live peer params and serves new
+    traffic, every allocator ends ``all_free``, and the survivor's step
+    programs compiled exactly once across the whole cycle."""
+    clock = VirtualClock()
+    fleet = _fleet(model_and_params, clock=clock, max_waiting=2)
+    rids = _submit_all(fleet, prompts, deadline_s=120.0)
+    for _ in range(3):
+        fleet.step()
+        clock.advance(0.05)
+    # both replicas mid-decode; lose the default victim (highest-id live)
+    fi.configure_faults("fleet_replica_loss:1")
+    try:
+        ev = fleet.poll_health(step=3)
+    finally:
+        fi.reset_faults()
+    assert isinstance(ev, ReplicaLostError) and ev.replica_id == 1
+    assert fleet.replicas[0].alive and not fleet.replicas[1].alive
+    assert fleet.replays > 0
+    # the dead replica's allocator is already fully drained
+    assert fleet.replicas[1].engine.allocator.all_free
+    # while shrunk: the single survivor's bounded queue fills -> the fleet
+    # sheds TYPED instead of wedging (admitted/replayed rows never shed)
+    shed_rids = [fleet.submit(prompts[0, :LENS[0]]) for _ in range(4)]
+    shed_states = [fleet.requests[r].state for r in shed_rids]
+    assert RequestState.REJECTED in shed_states
+    assert all(fleet.requests[r].finish_reason
+               in ("fleet_full", "queue_full")
+               for r in shed_rids
+               if fleet.requests[r].state is RequestState.REJECTED)
+    # every pre-loss request finishes token-identical to generate()
+    fleet.run()
+    _assert_rows_match_oracle(fleet, rids, dense_oracle)
+    # grow-back: probation, then admission from live peer params
+    fleet.note_return(1)
+    for p in range(4, 4 + fleet.probation_polls):
+        fleet.poll_health(step=p)
+    assert fleet.replicas[1].alive
+    returned = [e for e in fleet.events
+                if isinstance(e, ReplicaReturnedError)]
+    assert returned and "digest-verified" in returned[0].reason
+    # the healed replica's engine runs the live peer params (one sync)
+    assert fleet.replicas[1].engine.weight_syncs == 1
+    # new traffic lands on BOTH replicas and stays token-identical
+    routed_before = fleet.replicas[1].routed
+    rids2 = _submit_all(fleet, prompts)
+    fleet.run()
+    _assert_rows_match_oracle(fleet, rids2, dense_oracle)
+    assert fleet.replicas[1].routed > routed_before
+    assert fleet.all_free()
+    # the survivor never recompiled: one program per step width
+    for width, fn in fleet.replicas[0].engine._steps.items():
+        assert_compiles_once(fn, f"fleet survivor step width={width}")
+    fleet.teardown()
+    assert rep.live_stores_snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# Coordinator classification
+# ---------------------------------------------------------------------------
+class _FakeCoordinator:
+    """Duck-typed ElasticCoordinator surface the fleet consumes."""
+
+    def __init__(self):
+        self.polls = 0
+        self.raise_exc = None
+        self.ready = None
+        self.admitted = []
+
+    def poll(self, step):
+        self.polls += 1
+        if self.raise_exc is not None:
+            exc, self.raise_exc = self.raise_exc, None
+            raise exc
+
+    def ready_to_readmit(self):
+        return self.ready
+
+    def admit(self, slice_id, step):
+        self.admitted.append(slice_id)
+        self.ready = None
+
+
+def test_non_timeout_rpc_error_propagates_and_kills_nothing(
+        model_and_params):
+    """The training classification rule, on the serving path: only the
+    coordinator's own timeout verdict (SliceLostError) may shrink the
+    fleet — a transient RPC error propagates untouched and every replica
+    stays alive."""
+    coord = _FakeCoordinator()
+    fleet = _fleet(model_and_params, coordinator=coord)
+    coord.raise_exc = RuntimeError("connection reset by peer")
+    with pytest.raises(RuntimeError, match="connection reset"):
+        fleet.poll_health(step=0)
+    assert all(r.alive for r in fleet.replicas)
+    assert fleet.replica_losses == 0
+
+
+def test_coordinator_slice_loss_maps_to_replica_and_readmits(
+        model_and_params, prompts, dense_oracle):
+    """A real SliceLostError out of the coordinator's poll loses exactly
+    the replica serving that slice; the coordinator's readmit verdict
+    (its own probation already served) admits it back."""
+    coord = _FakeCoordinator()
+    fleet = _fleet(model_and_params, coordinator=coord)
+    rids = _submit_all(fleet, prompts)
+    fleet.step()
+    coord.raise_exc = SliceLostError(0, "heartbeat deadline missed", 1)
+    ev = fleet.poll_health(step=1)
+    assert isinstance(ev, ReplicaLostError) and ev.replica_id == 0
+    assert not fleet.replicas[0].alive and fleet.replicas[1].alive
+    fleet.run()
+    _assert_rows_match_oracle(fleet, rids, dense_oracle)
+    coord.ready = 0
+    ev = fleet.poll_health(step=2)
+    assert isinstance(ev, ReplicaReturnedError)
+    assert coord.admitted == [0]
+    assert fleet.replicas[0].alive
+    assert fleet.all_free()
+
+
+# ---------------------------------------------------------------------------
+# Live-params transport (checkpoint/replication.py)
+# ---------------------------------------------------------------------------
+def _tiny_tree():
+    return {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.ones((4,), np.float32)}
+
+
+def _abstract(tree):
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(np.asarray(a).shape,
+                                       np.asarray(a).dtype), tree)
+
+
+def test_live_params_push_fetch_digest_verified(tmp_path):
+    tree = _tiny_tree()
+    entry = rep.push_live_params(replica_id=0, params=tree, version=3,
+                                 catalog_dir=str(tmp_path))
+    assert rep.live_stores_snapshot() == {0: (3, 2)}
+    mirror = tmp_path / f"{rep.LIVE_CATALOG_FILE_PREFIX}.r0.json"
+    assert mirror.exists()
+    got = rep.fetch_live_params(abstract=_abstract(tree), replica_id=0,
+                                version=3)
+    assert got is not None
+    np.testing.assert_array_equal(got["w"], tree["w"])
+    # a version pin catches the peer syncing weights mid-admission
+    assert rep.fetch_live_params(abstract=_abstract(tree), replica_id=0,
+                                 version=4) is None
+    # a corrupted shard fails its sha256 -> typed None, never bad params
+    digest, buf, dtype, shape = entry.shards["['w']"]
+    entry.shards["['w']"] = (digest, b"\x00" * len(buf), dtype, shape)
+    assert rep.fetch_live_params(abstract=_abstract(tree),
+                                 replica_id=0) is None
+
+
+def test_drop_live_params_retracts_advertisement(tmp_path):
+    tree = _tiny_tree()
+    rep.push_live_params(replica_id=2, params=tree, version=1,
+                         catalog_dir=str(tmp_path))
+    mirror = tmp_path / f"{rep.LIVE_CATALOG_FILE_PREFIX}.r2.json"
+    assert mirror.exists()
+    assert rep.drop_live_params(2, catalog_dir=str(tmp_path))
+    assert rep.live_stores_snapshot() == {}
+    assert not mirror.exists()          # stale catalog cannot outlive it
+    assert rep.fetch_live_params(abstract=_abstract(tree),
+                                 replica_id=2) is None
+    assert not rep.drop_live_params(2)  # idempotent
+
+
+@pytest.mark.fault
+def test_replica_loss_drops_live_advertisement(model_and_params,
+                                               monkeypatch):
+    """The small-fix rule end-to-end: losing a replica retracts its
+    live-params advertisement, so a stale catalog can never warm a
+    newcomer from a dead replica."""
+    monkeypatch.setenv("AUTOMODEL_LOST_REPLICA", "0")
+    model, params = model_and_params
+    fleet = _fleet(model_and_params)
+    host = jax.tree.map(np.asarray, jax.device_get(params))
+    rep.push_live_params(replica_id=0, params=host, version=0)
+    assert 0 in rep.live_stores_snapshot()
+    fi.configure_faults("fleet_replica_loss:1")
+    try:
+        fleet.poll_health(step=0)
+    finally:
+        fi.reset_faults()
+    assert 0 not in rep.live_stores_snapshot()

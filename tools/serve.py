@@ -22,11 +22,22 @@ Robustness drills (docs/guides/serving.md "Production hardening"):
   aborted/expired/rejected/unfinished request exits 1 with the summary
   printed — so a CI drill that silently sheds work cannot pass.
 
+Elastic fleet (docs/guides/serving.md "Elastic fleet"): ``--replicas N``
+drives the same trace through a :class:`FleetRouter` over N per-slice
+engines (``--router-policy`` picks the routing policy), and
+``--drill-loss-at K`` arms ``fleet_replica_loss`` on the K-th health poll
+— the drive loop polls fleet health every step, so the drill loses a
+replica mid-traffic, replays its requests on survivors, then heals it
+through probation + live-peer-params admission.  The exit contract is
+unchanged: 0 only when every request FINISHED — a loss the fleet fails
+to absorb cannot pass CI.
+
     python tools/serve.py --config examples/serve/tiny_llama_serve.yaml
     python tools/serve.py --config ... --requests 32 --kv-dtype int8
     python tools/serve.py --config ... --deadline-s 30 --watchdog-s 10
     python tools/serve.py --config ... --fault serve_watchdog_stall:3
     python tools/serve.py --config ... --eval --limit 16
+    python tools/serve.py --config ... --replicas 2 --drill-loss-at 5
 """
 
 from __future__ import annotations
@@ -74,6 +85,48 @@ def _drive(engine, prompts, *, deadline_s, max_queue_s, drain_grace_s,
     return {"wall_s": time.perf_counter() - t0, "drained": drained}
 
 
+def _drive_fleet(fleet, prompts, *, deadline_s, max_queue_s, drain_grace_s,
+                 handler) -> dict:
+    """The fleet-mode drive: same contract as :func:`_drive`, plus one
+    fleet health poll per step (the loop IS the health-poll cadence an
+    operator deployment would run) and automatic grow-back: once a drill
+    loses a replica, it is marked returning so subsequent polls walk it
+    through probation and the live-peer-params admission."""
+    t0 = time.perf_counter()
+    drained = False
+    for p in prompts:
+        fleet.submit(p, deadline_s=deadline_s, max_queue_s=max_queue_s)
+    from automodel_tpu.serving.kv_cache import blocks_needed
+
+    max_steps = 64 + 8 * sum(
+        blocks_needed(len(r.prompt), fleet.config.prefill_chunk)
+        + r.max_new_tokens + 1
+        for r in fleet.requests.values() if not r.finished)
+    steps = 0
+    while fleet.has_work():
+        if handler is not None and handler.received:
+            fleet.drain(drain_grace_s)
+            drained = True
+            break
+        fleet.poll_health(step=steps)
+        for rep in fleet.replicas:
+            if not rep.alive:
+                fleet.note_return(rep.replica_id)
+        fleet.step()
+        steps += 1
+        if steps > max_steps:
+            raise RuntimeError(
+                f"fleet made no progress within {max_steps} steps — "
+                "scheduler stall (file a bug with the request trace)")
+    # a drill that lost a replica late may still be mid-probation: keep
+    # polling (idle — no traffic) until grow-back lands or gives up
+    for extra in range(steps, steps + 4 * fleet.probation_polls):
+        if all(r.alive for r in fleet.replicas):
+            break
+        fleet.poll_health(step=extra)
+    return {"wall_s": time.perf_counter() - t0, "drained": drained}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", "-c", required=True)
@@ -98,6 +151,16 @@ def main(argv=None) -> int:
     ap.add_argument("--drain-grace-s", type=float, default=None,
                     help="drain window after SIGTERM/SIGINT "
                          "(default: serving.drain_grace_s)")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="override serving.replicas (>1 drives a "
+                         "FleetRouter over per-slice engines)")
+    ap.add_argument("--router-policy", default=None,
+                    help="override serving.router_policy "
+                         "(round_robin/least_loaded/by_deadline)")
+    ap.add_argument("--drill-loss-at", type=int, default=None,
+                    help="arm fleet_replica_loss on the Nth health poll "
+                         "(the drive loop polls once per step); implies "
+                         "fleet mode")
     ap.add_argument("--fault", default=None,
                     help="arm a fault-injection spec for CI drills, e.g. "
                          "'serve_block_alloc:3,serve_watchdog_stall:5'")
@@ -112,7 +175,11 @@ def main(argv=None) -> int:
 
     from automodel_tpu.config.loader import load_yaml_config
     from automodel_tpu.generation import GenerationConfig
-    from automodel_tpu.serving import DecodeEngine, build_serving_config
+    from automodel_tpu.serving import (
+        DecodeEngine,
+        FleetRouter,
+        build_serving_config,
+    )
     from automodel_tpu.training.timers import SERVE_TIMERS, Timers
     from automodel_tpu.utils import fault_injection as fi
     from automodel_tpu.utils.sig_utils import DistributedSignalHandler
@@ -123,7 +190,9 @@ def main(argv=None) -> int:
                          ("watchdog_s", "serving.watchdog_s"),
                          ("max_waiting", "serving.max_waiting"),
                          ("shed_policy", "serving.shed_policy"),
-                         ("drain_grace_s", "serving.drain_grace_s")):
+                         ("drain_grace_s", "serving.drain_grace_s"),
+                         ("replicas", "serving.replicas"),
+                         ("router_policy", "serving.router_policy")):
         v = getattr(args, flag)
         if v is not None:
             cfg.set_by_dotted(dotted, v)
@@ -145,32 +214,47 @@ def main(argv=None) -> int:
         print(json.dumps(report))
         return 0
 
-    if args.fault:
-        fi.configure_faults(args.fault)
+    fleet_mode = (scfg.replicas or 1) > 1 or args.drill_loss_at is not None
+    fault_spec = args.fault
+    if args.drill_loss_at is not None:
+        drill = f"fleet_replica_loss:{args.drill_loss_at}"
+        fault_spec = f"{fault_spec},{drill}" if fault_spec else drill
+    if fault_spec:
+        fi.configure_faults(fault_spec)
     timers = Timers()
-    engine = DecodeEngine(model, params, scfg, generation=gen,
-                          timers=timers)
+    if fleet_mode:
+        engine = FleetRouter(model, params, scfg, generation=gen,
+                             timers=timers)
+    else:
+        engine = DecodeEngine(model, params, scfg, generation=gen,
+                              timers=timers)
     vocab = model.config.vocab_size
     rng = np.random.default_rng(args.seed)
     prompts = [rng.integers(1, vocab, int(n)).tolist()
                for n in rng.integers(
                    4, max(5, scfg.max_model_len - gen.max_new_tokens),
                    args.requests)]
-    engine.submit(prompts[0])          # warm compiles off the clock
+    # warm compiles off the clock (fleet: one request per replica so every
+    # engine's step widths are compiled before traffic)
+    for _ in range(len(engine.replicas) if fleet_mode else 1):
+        engine.submit(prompts[0])
     engine.run()
     # GKE preemption (SIGTERM) and operator ^C both take the graceful
     # drain; a SECOND ^C chains the default handler so a hung drain stays
     # abortable — the trainer's grace-window pattern.
     with DistributedSignalHandler([signal.SIGTERM, signal.SIGINT]) as h:
-        drive = _drive(engine, prompts, deadline_s=args.deadline_s,
-                       max_queue_s=args.max_queue_s,
-                       drain_grace_s=args.drain_grace_s
-                       if args.drain_grace_s is not None
-                       else scfg.drain_grace_s, handler=h)
-    if args.fault:
+        drive_fn = _drive_fleet if fleet_mode else _drive
+        drive = drive_fn(engine, prompts, deadline_s=args.deadline_s,
+                         max_queue_s=args.max_queue_s,
+                         drain_grace_s=args.drain_grace_s
+                         if args.drain_grace_s is not None
+                         else scfg.drain_grace_s, handler=h)
+    if fault_spec:
         fi.reset_faults()
     stats = engine.stats()
     outcomes = engine.outcome_counts()
+    if fleet_mode:
+        engine.teardown()   # retract live-params advertisements
     # the warm-up request is part of self.requests: it finished pre-drive
     not_finished = sum(n for state, n in outcomes.items()
                        if state != "finished")
