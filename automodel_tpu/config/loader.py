@@ -366,7 +366,10 @@ def _enum_fields():
     from automodel_tpu.post_training.losses import PT_ALGORITHMS
     from automodel_tpu.post_training.rollout import REWARD_SOURCES
     from automodel_tpu.serving.fleet import ROUTER_POLICIES
-    from automodel_tpu.serving.kv_cache import KV_CACHE_DTYPES
+    from automodel_tpu.serving.kv_cache import (
+        KV_CACHE_DTYPES,
+        PREFIX_CACHING_MODES,
+    )
     from automodel_tpu.serving.scheduler import (
         SCHEDULER_POLICIES,
         SHED_POLICIES,
@@ -380,6 +383,7 @@ def _enum_fields():
         "fp8.dtype": QUANT_DTYPES,
         "fp8.recipe_name": QUANT_RECIPES,
         "serving.kv_cache_dtype": KV_CACHE_DTYPES,
+        "serving.prefix_caching": PREFIX_CACHING_MODES,
         "serving.scheduler_policy": SCHEDULER_POLICIES,
         "serving.shed_policy": SHED_POLICIES,
         "serving.router_policy": ROUTER_POLICIES,
@@ -394,9 +398,12 @@ def _enum_normalizers():
     spellings).  ``kernels.autotune: on`` is a YAML 1.1 bool literal, so
     bools must map back onto the mode names before the membership check."""
     from automodel_tpu.ops.kernel_lib.autotune import normalize_autotune_mode
+    from automodel_tpu.serving.kv_cache import normalize_prefix_caching
 
     return {
         "kernels.autotune": normalize_autotune_mode,
+        # ``serving.prefix_caching: on`` is likewise a YAML 1.1 bool
+        "serving.prefix_caching": normalize_prefix_caching,
     }
 
 
@@ -419,6 +426,9 @@ _POSITIVE_INT_FIELDS = ("pipeline.pp_size", "pipeline.num_microbatches",
                         # router)
                         "serving.replicas",
                         "serving.fleet_probation_polls",
+                        # prefix-cache warm-LRU bound (a typo'd size must
+                        # fail at load, not as silent zero caching)
+                        "serving.prefix_lru_blocks",
                         # post-training rollout geometry (a typo'd group
                         # size must fail at load, not as a reshape error in
                         # the advantage normalizer)

@@ -215,6 +215,10 @@ class RolloutWorker:
                    for p in prompts]
         if any(not p for p in prompts):
             raise ValueError("rollout: empty prompt")
+        # group-level fork accounting: with prefix caching on, the G group
+        # members hit one prompt's chain, so a group pays ~1 prefill —
+        # report the tokens THIS rollout did not recompute
+        saved0 = eng.scheduler.prefix_tokens_reused
         t0 = time.perf_counter()
         rids: List[int] = []
         try:
@@ -272,6 +276,13 @@ class RolloutWorker:
                 "tokens": float(sum(len(c) for c in completions)),
                 "tokens_per_s": (sum(len(c) for c in completions)
                                  / max(self.last_rollout_s, 1e-9)),
+                "prefill_tokens_saved": float(
+                    eng.scheduler.prefix_tokens_reused - saved0),
+                "cache_hit_rate": (
+                    eng.prefix_index.hits
+                    / max(1, eng.prefix_index.lookups)
+                    if getattr(eng, "prefix_index", None) is not None
+                    else 0.0),
             })
         return batch
 

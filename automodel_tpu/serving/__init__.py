@@ -4,7 +4,9 @@ quantizable KV cache (see ``docs/guides/serving.md``).
 Layout::
 
     serving/
-      kv_cache.py   block pools + allocator + the PagedKVView pytree
+      kv_cache.py   block pools + refcounting allocator + the PagedKVView
+                    pytree + the content-hash PrefixIndex (shared blocks,
+                    copy-on-write forks)
       scheduler.py  per-request state machine, chunked prefill, preemption,
                     deadlines/TTLs, admission control, the pin breaker
       engine.py     static-shape jitted steps + the host decode loop,
@@ -29,9 +31,11 @@ from automodel_tpu.serving.fleet import (           # noqa: F401
 )
 from automodel_tpu.serving.kv_cache import (        # noqa: F401
     KV_CACHE_DTYPES,
+    PREFIX_CACHING_MODES,
     BlockAllocator,
     OutOfBlocks,
     PagedKVView,
+    PrefixIndex,
 )
 from automodel_tpu.serving.scheduler import (       # noqa: F401
     SCHEDULER_POLICIES,

@@ -65,14 +65,19 @@ import numpy as np
 from automodel_tpu.generation.generate import GenerationConfig, sample_logits
 from automodel_tpu.serving.kv_cache import (
     DEFAULT_KV_CACHE_DTYPE,
+    DEFAULT_PREFIX_CACHING,
     BlockAllocator,
     PagedKVView,
+    PrefixIndex,
     blocks_needed,
+    cow_copy_blocks,
     init_paged_pools,
     normalize_kv_cache_dtype,
+    normalize_prefix_caching,
     pool_bytes,
     slot_for,
     validate_kv_cache_dtype,
+    validate_prefix_caching,
 )
 from automodel_tpu.serving.scheduler import (
     DEFAULT_SCHEDULER_POLICY,
@@ -113,6 +118,9 @@ class ServingConfig:
     # -- robustness layer (docs/guides/serving.md "Production hardening") --
     max_waiting: Optional[int] = None        # None -> unbounded queue
     shed_policy: Optional[str] = None        # None -> reject_newest
+    # -- prefix caching (docs/guides/serving.md "Prefix caching") ----------
+    prefix_caching: Optional[str] = None     # None -> off (on/off, bools ok)
+    prefix_lru_blocks: Optional[int] = None  # None -> unbounded warm LRU
     max_preemptions: Optional[int] = None    # None -> never pin
     sjf_aging_steps: Optional[int] = None    # None -> default (32)
     watchdog_s: Optional[float] = None       # None -> watchdog disabled
@@ -136,7 +144,8 @@ class ServingConfig:
         from automodel_tpu.config.loader import normalize_null_spelling
 
         for field in ("max_waiting", "max_preemptions", "sjf_aging_steps",
-                      "replicas", "fleet_probation_polls"):
+                      "replicas", "fleet_probation_polls",
+                      "prefix_lru_blocks"):
             v = normalize_null_spelling(getattr(self, field))
             setattr(self, field, v)
             if v is None:
@@ -157,6 +166,8 @@ class ServingConfig:
                     f"to disable), got {v!r}")
         self.kv_cache_dtype = validate_kv_cache_dtype(
             normalize_kv_cache_dtype(self.kv_cache_dtype))
+        self.prefix_caching = validate_prefix_caching(
+            normalize_prefix_caching(self.prefix_caching))
         self.scheduler_policy = validate_scheduler_policy(
             normalize_scheduler_policy(self.scheduler_policy))
         self.shed_policy = validate_shed_policy(
@@ -200,13 +211,27 @@ def build_serving_config(cfg: Any) -> ServingConfig:
     return ServingConfig(**data)
 
 
-def _paged_step(model, block_size: int, quantized: bool, params, pools,
+def _paged_step(model, block_size: int, quantized: bool, cow_enabled: bool,
+                params, pools,
                 input_ids, positions, slot_mapping, block_tables,
-                context_lens, last_col):
-    """ONE traced program per step width: write this step's tokens into
-    the paged cache, attend, and greedy-pick each row's next token at its
-    last valid column.  Returns ``(greedy [B], last_logits [B, V],
-    pools)`` — pools donated, so the cache updates in place."""
+                context_lens, last_col, cow_src, cow_dst):
+    """ONE traced program per step width: run any pending copy-on-write
+    block forks, write this step's tokens into the paged cache, attend,
+    and greedy-pick each row's next token at its last valid column.
+    Returns ``(greedy [B], last_logits [B, V], pools)`` — pools donated,
+    so the cache updates in place.
+
+    ``cow_src``/``cow_dst`` are fixed ``[B]`` block-id pairs: rows with a
+    prefix-cache fork copy their shared last block into a private one
+    BEFORE this step's writes land; rows without carry ``(0, 0)`` — the
+    null page copied onto itself, a content no-op — so hit/miss/fork
+    steps share this one compiled program (no new shapes).
+    ``cow_enabled`` is a TRACE-TIME constant: with the prefix cache off
+    no fork can ever be scheduled, so the step compiles without the
+    per-step block copy (the cache-off path pays nothing; the args stay
+    in the signature so both modes keep one census)."""
+    if cow_enabled:
+        pools = cow_copy_blocks(pools, cow_src, cow_dst)
     view = PagedKVView(
         pools, block_tables, slot_mapping, context_lens, positions,
         block_size=block_size, quantized=quantized)
@@ -255,6 +280,12 @@ class DecodeEngine:
             quantized=self.quantized)
         self.pools = init_paged_pools(**self._pool_spec)
         self.allocator = BlockAllocator(num_blocks)
+        self.prefix_index: Optional[PrefixIndex] = None
+        if (self.config.prefix_caching
+                or DEFAULT_PREFIX_CACHING) == "on":
+            self.prefix_index = PrefixIndex(
+                self.allocator, block_size=self.config.kv_block_size,
+                lru_blocks=self.config.prefix_lru_blocks)
         self.scheduler = Scheduler(
             self.allocator, max_num_seqs=self.config.max_num_seqs,
             prefill_chunk=self.config.prefill_chunk,
@@ -267,6 +298,7 @@ class DecodeEngine:
             max_preemptions=self.config.max_preemptions,
             sjf_aging_steps=self.config.sjf_aging_steps
             or DEFAULT_SJF_AGING_STEPS,
+            prefix_index=self.prefix_index,
             clock=clock)
         self.requests: Dict[int, Request] = {}
         self.rejections: List[RequestRejected] = []
@@ -290,7 +322,8 @@ class DecodeEngine:
         if fn is None:
             fn = jax.jit(
                 functools.partial(_paged_step, self.model,
-                                  self.config.kv_block_size, self.quantized),
+                                  self.config.kv_block_size, self.quantized,
+                                  self.prefix_index is not None),
                 donate_argnums=(1,))
             self._steps[width] = fn
         return fn
@@ -443,6 +476,9 @@ class DecodeEngine:
         tables = np.zeros((B, MB), np.int32)
         ctx = np.ones((B,), np.int32)       # idle rows: 1 (null-page key 0)
         last = np.zeros((B,), np.int32)
+        # COW fork pairs: (0, 0) = null page onto itself = content no-op
+        cow_src = np.zeros((B,), np.int32)
+        cow_dst = np.zeros((B,), np.int32)
         for work in plan.active:
             b, t = work.req.slot, len(work.tokens)
             start = work.start_pos
@@ -455,7 +491,9 @@ class DecodeEngine:
                             for p in range(start, start + t)]
             ctx[b] = start + t
             last[b] = t - 1
-        return ids, pos, slots, tables, ctx, last
+            if work.cow is not None:
+                cow_src[b], cow_dst[b] = work.cow
+        return ids, pos, slots, tables, ctx, last, cow_src, cow_dst
 
     def _sample(self, row: int, greedy: np.ndarray,
                 last_logits) -> np.ndarray:
@@ -498,6 +536,10 @@ class DecodeEngine:
         # every table is back on the free list; zero pools replace the
         # untrusted donated buffers (cheap relative to the stall absorbed)
         self.pools = init_paged_pools(**self._pool_spec)
+        if self.prefix_index is not None:
+            # rebuilt pools zero the cached contents — a stale prefix hit
+            # would read garbage, so the index forgets everything
+            self.prefix_index.flush()
         self.watchdog_recoveries += 1
         self._no_progress_since = None
         if self.timers is not None:
@@ -537,7 +579,8 @@ class DecodeEngine:
             else:
                 self._no_progress_since = None       # idle is not a wedge
             return []
-        ids, pos, slots, tables, ctx, last = self._assemble(plan)
+        (ids, pos, slots, tables, ctx, last,
+         cow_src, cow_dst) = self._assemble(plan)
         try:
             # The drilled wedged-step site: an armed ``serve_watchdog_stall``
             # stands in for a device step that never completed (the runtime
@@ -545,7 +588,8 @@ class DecodeEngine:
             # path must absorb it without crashing the engine loop.
             fault_point("serve_watchdog_stall")
             greedy, last_logits, self.pools = self.step_fn(plan.step_width)(
-                self.params, self.pools, ids, pos, slots, tables, ctx, last)
+                self.params, self.pools, ids, pos, slots, tables, ctx, last,
+                cow_src, cow_dst)
             # the engine's one host sync: the [B] sampled tokens drive the
             # host-side request state machine
             greedy = np.asarray(jax.device_get(greedy))  # lint: disable=L004 (continuous batching IS a per-step host decision loop: one [B]-int fetch per step, the logits stay on device unless do_sample)
@@ -693,7 +737,25 @@ class DecodeEngine:
         return n
 
     def stats(self) -> Dict[str, Any]:
+        idx = self.prefix_index
+        sched = self.scheduler
+        prefix = {
+            "enabled": idx is not None,
+            "lookups": idx.lookups if idx else 0,
+            "hits": idx.hits if idx else 0,
+            "misses": idx.misses if idx else 0,
+            "insertions": idx.insertions if idx else 0,
+            "evictions": idx.evictions if idx else 0,
+            "cached_blocks": idx.cached_blocks if idx else 0,
+            "cow_forks": sched.cow_forks,
+            "cow_fork_failures": sched.cow_fork_failures,
+            "deferrals": sched.prefix_deferrals,
+        }
         return {
+            "prefill_tokens_saved": sched.prefix_tokens_reused,
+            "cache_hit_rate": (idx.hits / max(1, idx.lookups)
+                               if idx else 0.0),
+            "prefix_cache": prefix,
             "steps": self.steps_run,
             "decode_steps": self.decode_steps,
             "mixed_steps": self.mixed_steps,

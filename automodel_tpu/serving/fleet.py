@@ -587,6 +587,11 @@ class FleetRouter:
                     "tokens_generated": r.engine.tokens_generated,
                     "compiled_widths": sorted(r.engine._steps),
                     "kv_blocks_free": r.engine.allocator.free_blocks,
+                    "prefill_tokens_saved":
+                        r.engine.scheduler.prefix_tokens_reused,
                 } for r in self.replicas},
+            "prefill_tokens_saved": sum(
+                r.engine.scheduler.prefix_tokens_reused
+                for r in self.replicas),
             "outcomes": self.outcome_counts(),
         }

@@ -26,15 +26,34 @@ rides the same block layout as the data, so one block table addresses
 both.  Quantize/rescale reuses PR-10's machinery (``ops/quant.quant_cast``
 at write, broadcast rescale at read — in-VMEM inside the Pallas decode
 rung, XLA-fused in the gather fallback).
+
+**Prefix caching** (``serving.prefix_caching: on``) makes committed
+blocks shareable across requests: :class:`BlockAllocator` reference-counts
+every live block (``free`` is a decref; the pool reclaims at zero) and
+:class:`PrefixIndex` keys each FULL committed block by the hash chain
+``key = sha256(parent_key, block's token ids)`` — SGLang's RadixAttention
+design on the vLLM block substrate.  Lookup walks a request's tokens
+block-by-block and returns the longest cached chain; a refcount-zero
+indexed block parks in a warm LRU (still ON the free ledger, so
+``all_free`` stays the leak oracle) and is evicted only when the
+allocator genuinely needs it back — never from a live table.  The last,
+partially-covered block of a fully-cached sequence is COPY-ON-WRITE:
+the writer takes a private block and the jitted step runs
+:func:`cow_copy_blocks` (a fixed whole-block copy riding the existing
+step buffers — no new program shapes; int8 scale planes ride the same
+block ids, so sharing a block shares its scales).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # ``serving.kv_cache_dtype`` config domain (enum-validated at config load
 # like cp_layout / moe.dispatch — see loader._enum_fields).  ``auto``
@@ -59,6 +78,33 @@ def validate_kv_cache_dtype(v: Optional[str]) -> Optional[str]:
     return v
 
 
+# ``serving.prefix_caching`` config domain.  YAML ``on``/``off`` are 1.1
+# bool literals, so the normalizer maps real bools back onto the mode
+# names before the membership check — the ``kernels.autotune`` pattern.
+PREFIX_CACHING_MODES = ("off", "on")
+DEFAULT_PREFIX_CACHING = "off"
+
+
+def normalize_prefix_caching(v):
+    from automodel_tpu.config.loader import normalize_null_spelling
+
+    v = normalize_null_spelling(v)
+    if isinstance(v, bool):
+        return "on" if v else "off"
+    return v
+
+
+def validate_prefix_caching(v: Optional[str]) -> Optional[str]:
+    if v is None:
+        return None
+    if v not in PREFIX_CACHING_MODES:
+        raise ValueError(
+            f"serving.prefix_caching must be one of "
+            f"{list(PREFIX_CACHING_MODES)} (YAML on/off/true/false, or "
+            f"null for the default), got {v!r}")
+    return v
+
+
 class OutOfBlocks(RuntimeError):
     """KV pool exhausted — the scheduler converts this into a preemption
     (a request parked back to WAITING with its blocks freed), never a
@@ -66,15 +112,27 @@ class OutOfBlocks(RuntimeError):
 
 
 class BlockAllocator:
-    """Host-side free-list allocator over the pool's block ids.
+    """Host-side free-list allocator over the pool's block ids, with
+    per-block REFERENCE COUNTS so committed blocks can be shared across
+    requests (prefix caching).
 
     Block 0 is reserved as the null page (never handed out); allocation
     and free are O(1)-per-block ops on python ints — deterministic, no
-    device traffic.  A set mirror of the free list makes double-free
-    detection O(1) (it was an O(free) scan per freed block — quadratic on
-    the watchdog's reclaim-everything path).  ``peak_used`` /
+    device traffic.  ``allocate`` hands out blocks at refcount 1;
+    :meth:`incref` adds a holder (a prefix hit sharing the block);
+    :meth:`free` is a DECREF — the block returns to the free ledger only
+    when its last holder lets go, so preemption/abort/expiry/watchdog
+    reclaim and the fleet's ``harvest_for_replay`` all route through one
+    path and a shared block survives any one holder's death.
+
+    The set mirror of the free ledger keeps double-free detection O(1)
+    and extends unchanged to shared blocks: decref of a live block is
+    legal per holder, but freeing a block that already reached zero is
+    still the loud ``double free`` ValueError.  ``peak_used`` /
     ``failed_allocs`` feed the engine's stats; :attr:`all_free` is the
-    leak oracle the overload/fault drills pin after every terminal state.
+    leak oracle the overload/fault drills pin after every terminal state
+    — refcount-zero blocks a :class:`PrefixIndex` keeps warm count as
+    free (they are reclaimable on demand, just not yet recycled).
     """
 
     def __init__(self, num_blocks: int):
@@ -84,39 +142,76 @@ class BlockAllocator:
         self.num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
         self._free_set = set(self._free)
+        self._refs: Dict[int, int] = {}      # live block -> holder count
+        self.prefix_index: Optional["PrefixIndex"] = None
         self.peak_used = 0
         self.failed_allocs = 0
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        # the full free ledger: the plain free list PLUS index-warmed
+        # refcount-zero blocks (evictable on demand)
+        return len(self._free_set)
 
     @property
     def used_blocks(self) -> int:
-        return (self.num_blocks - 1) - len(self._free)
+        return (self.num_blocks - 1) - len(self._free_set)
 
     @property
     def all_free(self) -> bool:
-        """True when every allocable block is back on the free list — the
+        """True when every allocable block is back on the free ledger — the
         no-leak invariant every request's terminal transition (FINISHED,
         ABORTED, EXPIRED, REJECTED, preempted, watchdog-replayed) must
-        restore once no request holds a table."""
-        return len(self._free) == self.num_blocks - 1
+        restore once no request holds a table.  Blocks the prefix index
+        keeps warm at refcount zero ARE free: cached, not leaked."""
+        return len(self._free_set) == self.num_blocks - 1
+
+    def ref_count(self, block: int) -> int:
+        """Current holder count of ``block`` (0 when free/cached-free)."""
+        return self._refs.get(block, 0)
 
     def allocate(self, n: int) -> List[int]:
-        """``n`` block ids, or :class:`OutOfBlocks` (nothing handed out —
-        all-or-nothing, so a failed grab never leaks)."""
-        if n > len(self._free):
+        """``n`` block ids at refcount 1, or :class:`OutOfBlocks` (nothing
+        handed out — all-or-nothing, so a failed grab never leaks).
+        Uncached free blocks are preferred; only when those run out does
+        the prefix index evict (LRU) from its warm refcount-zero pool —
+        never from a live table."""
+        if n > len(self._free_set):
             self.failed_allocs += 1
             raise OutOfBlocks(
                 f"KV pool exhausted: requested {n} blocks, "
-                f"{len(self._free)} free of {self.num_blocks - 1}")
-        out = [self._free.pop() for _ in range(n)]
+                f"{len(self._free_set)} free of {self.num_blocks - 1}")
+        out = []
+        for _ in range(n):
+            b = (self._free.pop() if self._free
+                 else self.prefix_index.evict_lru())
+            self._refs[b] = 1
+            out.append(b)
         self._free_set.difference_update(out)
         self.peak_used = max(self.peak_used, self.used_blocks)
         return out
 
+    def incref(self, blocks: List[int]) -> None:
+        """Add one holder to each LIVE block (a prefix hit sharing it)."""
+        for b in blocks:
+            if b not in self._refs:
+                raise ValueError(f"incref of non-live block {b}")
+            self._refs[b] += 1
+
+    def revive(self, block: int) -> None:
+        """A prefix hit on an index-warmed refcount-zero block: pull it
+        back off the free ledger at refcount 1 (the PrefixIndex removes it
+        from its own LRU before calling)."""
+        if block not in self._free_set:
+            raise ValueError(f"revive of non-free block {block}")
+        self._free_set.discard(block)
+        self._refs[block] = 1
+        self.peak_used = max(self.peak_used, self.used_blocks)
+
     def free(self, blocks: List[int]) -> None:
+        """DECREF each block; a block whose last holder released returns
+        to the free ledger (parked warm when the prefix index still maps
+        it, else straight onto the free list)."""
         if len(set(blocks)) != len(blocks):
             raise ValueError(f"duplicate block ids in free(): {blocks}")
         for b in blocks:
@@ -124,8 +219,165 @@ class BlockAllocator:
                 raise ValueError(f"freeing unknown block id {b}")
             if b in self._free_set:
                 raise ValueError(f"double free of block {b}")
-        self._free.extend(reversed(blocks))
-        self._free_set.update(blocks)
+        for b in blocks:
+            self._refs[b] -= 1
+            if self._refs[b] > 0:
+                continue                 # another holder keeps it live
+            del self._refs[b]
+            self._free_set.add(b)
+            if not (self.prefix_index is not None
+                    and self.prefix_index.retain_freed(b)):
+                self._free.append(b)
+
+
+class PrefixIndex:
+    """Content-hash index over FULL committed KV blocks — the sharing
+    substrate of ``serving.prefix_caching``.
+
+    Each entry keys one block by its hash chain::
+
+        key = sha256(parent_key || block's token ids)
+
+    so two sequences share exactly their common block-aligned prefix and
+    a lookup needs no token comparison — walking the chain key-by-key
+    finds the longest cached run of full blocks.  Eviction rules:
+
+    * a LIVE block (refcount >= 1) is never evicted — its entry simply
+      rides along while requests share it;
+    * at refcount zero the block parks in the warm LRU (``lru_blocks``
+      bounds it; ``None`` keeps every free block warm) — still on the
+      allocator's free ledger, so ``all_free`` is unchanged;
+    * the allocator evicts warm blocks LRU-last only when its plain free
+      list runs dry, and :meth:`flush` (watchdog pool rebuild) forgets
+      everything at once — rebuilt pools zero the contents, so a stale
+      hit would read garbage.
+    """
+
+    def __init__(self, allocator: BlockAllocator, *, block_size: int,
+                 lru_blocks: Optional[int] = None):
+        self.allocator = allocator
+        allocator.prefix_index = self
+        self.block_size = block_size
+        self.lru_blocks = lru_blocks
+        self._by_key: Dict[str, int] = {}
+        self._by_block: Dict[int, str] = {}
+        self._cached_free: "OrderedDict[int, None]" = OrderedDict()
+        self.lookups = 0
+        self.hits = 0
+        self.misses = 0
+        self.insertions = 0
+        self.evictions = 0
+
+    @staticmethod
+    def chain_key(parent_key: Optional[str], tokens) -> str:
+        h = hashlib.sha256()
+        h.update((parent_key or "").encode("ascii"))
+        h.update(np.asarray(list(tokens), dtype=np.int64).tobytes())
+        return h.hexdigest()
+
+    def chain_keys(self, tokens) -> List[str]:
+        """The hash-chain keys of every FULL block of ``tokens``."""
+        bs = self.block_size
+        keys: List[str] = []
+        parent: Optional[str] = None
+        for i in range(len(tokens) // bs):
+            parent = self.chain_key(parent, tokens[i * bs:(i + 1) * bs])
+            keys.append(parent)
+        return keys
+
+    def has_key(self, key: str) -> bool:
+        return key in self._by_key
+
+    def peek(self, keys: List[str]) -> int:
+        """Length of the cached leading chain — no refs taken (the
+        admission-guard / deferral probe)."""
+        n = 0
+        for k in keys:
+            if k not in self._by_key:
+                break
+            n += 1
+        return n
+
+    def acquire(self, keys: List[str]) -> List[int]:
+        """Take one reference on each block of the longest cached leading
+        chain and return their ids (warm refcount-zero blocks are revived,
+        live ones increfed)."""
+        self.lookups += 1
+        chain: List[int] = []
+        for k in keys:
+            b = self._by_key.get(k)
+            if b is None:
+                break
+            if b in self._cached_free:
+                del self._cached_free[b]
+                self.allocator.revive(b)
+            else:
+                self.allocator.incref([b])
+            chain.append(b)
+        if chain:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return chain
+
+    def commit(self, parent_key: Optional[str], tokens, block_id: int) -> str:
+        """Register one FULL committed block under its chain key.  First
+        writer wins: when the content is already indexed (a concurrent
+        twin, or a COW fork recomputing a cached block) the existing entry
+        is kept and ``block_id`` stays private.  Returns the key either
+        way — the caller's chain parent for the next block."""
+        key = self.chain_key(parent_key, tokens)
+        if key in self._by_key or block_id in self._by_block:
+            return key
+        self._by_key[key] = block_id
+        self._by_block[block_id] = key
+        self.insertions += 1
+        return key
+
+    def retain_freed(self, block: int) -> bool:
+        """Allocator hook at refcount zero: park an indexed block in the
+        warm LRU (True) or decline (False -> the plain free list).  An
+        over-bound LRU evicts its coldest entries back to the free list."""
+        if block not in self._by_block:
+            return False
+        self._cached_free[block] = None
+        if self.lru_blocks is not None:
+            while len(self._cached_free) > self.lru_blocks:
+                self.allocator._free.append(self.evict_lru())
+        return True
+
+    def evict_lru(self) -> int:
+        """Drop the least-recently-parked refcount-zero entry and return
+        its block id (the caller decides the destination: the allocator
+        hands it out, ``retain_freed`` returns it to the free list)."""
+        b, _ = self._cached_free.popitem(last=False)
+        del self._by_key[self._by_block.pop(b)]
+        self.evictions += 1
+        return b
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._by_key)
+
+    def flush(self) -> None:
+        """Forget every entry (the watchdog's pool rebuild zeroes cached
+        contents); warm blocks rejoin the plain free list."""
+        self.allocator._free.extend(self._cached_free)
+        self._cached_free.clear()
+        self._by_key.clear()
+        self._by_block.clear()
+
+
+def cow_copy_blocks(pools: Dict[str, jnp.ndarray], src: jnp.ndarray,
+                    dst: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """The jitted copy-on-write fork: whole-block copy ``src[b] -> dst[b]``
+    per step row across EVERY pool plane (int8 scale planes ride the same
+    block ids, so a forked block carries its scales).  Fixed ``[B]``-pair
+    shapes ride the existing step buffers — rows without a fork carry
+    ``(0, 0)``, copying the null page onto itself (a content no-op) — so
+    hit/miss/fork steps all share one compiled program per width."""
+    return {name: pool.at[:, dst].set(pool[:, src])
+            for name, pool in pools.items()}
 
 
 def init_paged_pools(*, num_layers: int, num_kv_heads: int, head_dim: int,

@@ -66,6 +66,20 @@ Scheduling policies (``serving.scheduler_policy``):
   re-admits ahead of the request that displaced it).
 * ``sjf``  — shortest pending work first with aging (above): better p50
   under mixed lengths without the textbook starvation failure.
+
+Prefix caching (``serving.prefix_caching: on``): admission consults the
+:class:`~automodel_tpu.serving.kv_cache.PrefixIndex` — a hit seeds the
+request's block table with shared block ids and starts ``num_computed``
+at the cached length, so chunked prefill covers only the cold tail.  A
+fully-cached sequence forks its last block COPY-ON-WRITE (a private block
+the jitted step copies the shared slots into, before any write).  Every
+release path (finish, abort, expiry, preemption, watchdog/fleet replay)
+already routes through ``allocator.free`` — now a decref — so a shared
+block survives any one holder's death.  Concurrent identical prompts
+(a GRPO group) are handled by DEFERRAL: a cold request whose next
+uncached block is already being computed by an admitted twin waits one
+tick instead of paying a duplicate prefill — the group converges to ~1
+prompt prefill (the group-level rollout fork).
 """
 
 from __future__ import annotations
@@ -79,6 +93,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 from automodel_tpu.serving.kv_cache import (
     BlockAllocator,
     OutOfBlocks,
+    PrefixIndex,
     blocks_needed,
 )
 from automodel_tpu.utils.fault_injection import InjectedFault, fault_point
@@ -175,6 +190,18 @@ class Request:
     # and the queue TTL all treat them as admitted work — only the
     # deadline (and pool pressure) governs them after first admission.
     was_admitted: bool = False           # ever held a step slot
+    # -- prefix caching ----------------------------------------------------
+    # A pending COW fork: the step copies block cow_src -> cow_dst before
+    # writing; the src ref is HELD until the copy rode a step (or the
+    # request released), so the shared source can never be reclaimed and
+    # rewritten underneath the fork.
+    cow_src: Optional[int] = None
+    cow_dst: Optional[int] = None
+    chain_key: Optional[str] = None      # hash-chain parent of the next commit
+    committed_blocks: int = 0            # leading blocks already indexed
+    # uncached chain keys this admitted request will commit (the deferral
+    # signal concurrent identical prompts wait on)
+    inflight_keys: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def seq(self) -> List[int]:
@@ -219,6 +246,9 @@ class RowWork:
     tokens: List[int]
     start_pos: int
     samples_next: bool
+    # (src, dst) whole-block COW copy the step must run BEFORE this row's
+    # writes; None for the common no-fork case
+    cow: Optional[tuple] = None
 
 
 @dataclasses.dataclass
@@ -241,6 +271,7 @@ class Scheduler:
                  shed_policy: str = DEFAULT_SHED_POLICY,
                  max_preemptions: Optional[int] = None,
                  sjf_aging_steps: int = DEFAULT_SJF_AGING_STEPS,
+                 prefix_index: Optional[PrefixIndex] = None,
                  clock: Callable[[], float] = time.monotonic):
         policy = validate_scheduler_policy(normalize_scheduler_policy(policy))
         shed_policy = validate_shed_policy(
@@ -267,6 +298,17 @@ class Scheduler:
         self.expired = 0
         self.rejected = 0
         self.pins = 0
+        # -- prefix caching (counters live even with the index off, so
+        # engine/fleet stats read one shape either way) -------------------
+        self.prefix_index = prefix_index
+        self.prefix_tokens_reused = 0    # prompt tokens NOT re-prefilled
+        self.prompt_tokens = 0           # all submitted prompt tokens
+        self.cow_forks = 0
+        self.cow_fork_failures = 0
+        self.prefix_deferrals = 0
+        # chain key -> count of admitted requests about to commit it (the
+        # deferral signal for concurrent identical prompts)
+        self._inflight_keys: Dict[str, int] = {}
 
     # -- intake ------------------------------------------------------------
     def add(self, req: Request) -> List[RequestRejected]:
@@ -282,13 +324,23 @@ class Scheduler:
                 f"request {req.rid}: prompt {len(req.prompt)} + "
                 f"max_new_tokens {req.max_new_tokens} exceeds "
                 f"serving.max_model_len {self.max_model_len}")
-        if blocks_needed(total, self.block_size) \
-                > self.allocator.num_blocks - 1:
+        worst = blocks_needed(total, self.block_size)
+        if self.prefix_index is not None:
+            # A prefix hit means the leading cached blocks are SHARED, not
+            # consumed: discount them from the worst case (keeping a
+            # one-block margin for the COW fork) so a request whose prompt
+            # is fully cached is not rejected for a pool it will barely
+            # touch.  The pool-pressure machinery (preemption/parking)
+            # still governs actual growth.
+            cached = self.prefix_index.peek(
+                self.prefix_index.chain_keys(req.prompt))
+            worst -= max(0, cached - 1)
+        if worst > self.allocator.num_blocks - 1:
             raise ValueError(
-                f"request {req.rid} needs "
-                f"{blocks_needed(total, self.block_size)} KV blocks but the "
+                f"request {req.rid} needs {worst} KV blocks but the "
                 f"pool has {self.allocator.num_blocks - 1} — raise "
                 "serving.num_kv_blocks / max_model_len")
+        self.prompt_tokens += len(req.prompt)
         req.arrival = self._arrivals
         self._arrivals += 1
         req.submit_time = self.clock()
@@ -368,15 +420,29 @@ class Scheduler:
         self.expired += 1
 
     def _release(self, req: Request) -> None:
-        """Vacate slot + return the whole block table to the free list."""
+        """Vacate slot + decref the whole block table (and any pending COW
+        source ref) back to the allocator."""
         if req in self.waiting:
             self.waiting.remove(req)
         if req.slot is not None:
             self.slots[req.slot] = None
             req.slot = None
+        self._drop_chain_state(req)
         if req.blocks:
             self.allocator.free(req.blocks)
             req.blocks = []
+
+    def _drop_chain_state(self, req: Request) -> None:
+        """Forget a request's prefix-chain bookkeeping: release the held
+        COW-source ref and the in-flight commit claims.  The block TABLE
+        is the caller's to free — this never touches ``req.blocks``."""
+        if req.cow_src is not None:
+            self.allocator.free([req.cow_src])
+        req.cow_src = None
+        req.cow_dst = None
+        req.chain_key = None
+        req.committed_blocks = 0
+        self._unregister_inflight(req)
 
     def requeue_for_replay(self, req: Request) -> None:
         """Watchdog recovery: park an admitted request back to WAITING with
@@ -412,6 +478,14 @@ class Scheduler:
         req.slot = None
         req.blocks = []
         req.num_computed = 0
+        # the dead engine's chain state died with its pools: the refs were
+        # released by the harvest, and THIS scheduler's index re-seeds on
+        # re-admission
+        req.cow_src = None
+        req.cow_dst = None
+        req.chain_key = None
+        req.committed_blocks = 0
+        req.inflight_keys = []
         req.state = RequestState.WAITING
         req.pinned = True
         if req not in self.waiting:
@@ -455,6 +529,7 @@ class Scheduler:
         assert victim.slot is not None
         self.slots[victim.slot] = None
         victim.slot = None
+        self._drop_chain_state(victim)
         if victim.blocks:
             self.allocator.free(victim.blocks)
             victim.blocks = []
@@ -541,21 +616,143 @@ class Scheduler:
                 # data loss; only its deadline governs it now
                 self.expire(req, reason="queue_ttl")
 
+    # -- prefix caching ----------------------------------------------------
+    def _try_prefix_seed(self, req: Request) -> bool:
+        """Consult the prefix index for ``req`` at the admission boundary:
+        a hit seeds the block table with shared ids and fast-forwards
+        ``num_computed`` (chunked prefill covers only the cold tail); a
+        fully-cached sequence forks its last block copy-on-write.  Returns
+        True when admission should be DEFERRED this tick — the request's
+        next uncached block is already being computed by an admitted twin
+        (a GRPO group's followers wait for the leader's commits instead of
+        paying G duplicate prefills)."""
+        idx = self.prefix_index
+        if idx is None or req.blocks or req.num_computed:
+            return False         # cache off, or a replay already seeded/ran
+        tokens = req.seq
+        keys = idx.chain_keys(tokens)
+        if not keys:
+            return False
+        cached = idx.peek(keys)
+        if cached < len(keys) and keys[cached] in self._inflight_keys:
+            self.prefix_deferrals += 1
+            return True
+        if cached == 0:
+            return False
+        # The drilled lookup site: an armed ``kv_prefix_lookup`` degrades
+        # to a cold prefill — byte-identical output, just no reuse.
+        try:
+            fault_point("kv_prefix_lookup")
+        except InjectedFault:
+            idx.lookups += 1
+            idx.misses += 1
+            return False
+        chain = idx.acquire(keys)
+        matched = len(chain) * self.block_size
+        if matched > len(tokens) - 1:
+            # the chain covers the WHOLE sequence: the last block must be
+            # writable (the next decode token lands in it, or its final
+            # slot is the sampled-next position) — fork it copy-on-write.
+            # The drilled fork site: an armed ``kv_cow_fork`` (or genuine
+            # exhaustion) drops the chain and falls back to a cold
+            # prefill — the shared source block is never touched.
+            src = chain[-1]
+            try:
+                fault_point("kv_cow_fork")
+                dst = self.allocator.allocate(1)[0]
+            except (OutOfBlocks, InjectedFault):
+                self.allocator.free(chain)
+                self.cow_fork_failures += 1
+                return False
+            req.cow_src = src          # ref held until the copy rode a step
+            req.cow_dst = dst
+            chain = chain[:-1] + [dst]
+            matched -= 1               # dst's last slot is still cold
+            self.cow_forks += 1
+            req.committed_blocks = len(chain) - 1
+            req.chain_key = keys[len(chain) - 2] if len(chain) >= 2 else None
+        else:
+            req.committed_blocks = len(chain)
+            req.chain_key = keys[len(chain) - 1]
+        req.blocks = list(chain)
+        req.num_computed = matched
+        return False
+
+    def _unseed(self, req: Request) -> None:
+        """Back out a prefix seed when admission bounced AFTER seeding:
+        refs return to the allocator and the request is cold again."""
+        self._drop_chain_state(req)
+        if req.blocks:
+            self.allocator.free(req.blocks)
+            req.blocks = []
+        req.num_computed = 0
+
+    def _register_inflight(self, req: Request) -> None:
+        """Claim the uncached chain keys this admitted request will commit
+        as its prefill progresses — concurrent identical prompts defer on
+        these instead of duplicating the work."""
+        idx = self.prefix_index
+        if idx is None:
+            return
+        keys = idx.chain_keys(req.seq)
+        req.inflight_keys = [k for k in keys[req.committed_blocks:]
+                             if not idx.has_key(k)]
+        for k in req.inflight_keys:
+            self._inflight_keys[k] = self._inflight_keys.get(k, 0) + 1
+
+    def _unregister_inflight(self, req: Request) -> None:
+        for k in req.inflight_keys:
+            n = self._inflight_keys.get(k, 0) - 1
+            if n <= 0:
+                self._inflight_keys.pop(k, None)
+            else:
+                self._inflight_keys[k] = n
+        req.inflight_keys = []
+
+    def _commit_full(self, req: Request) -> None:
+        """Index every newly-FULL block of ``req`` (prompt AND decode
+        output — multi-turn reuse and preemption replay both hit them).
+        First writer wins on key collisions; committed keys leave the
+        in-flight claim so deferred twins admit next tick."""
+        idx = self.prefix_index
+        if idx is None:
+            return
+        bs = self.block_size
+        seq = req.seq
+        full = min(req.num_computed // bs, len(req.blocks))
+        while req.committed_blocks < full:
+            i = req.committed_blocks
+            key = idx.commit(req.chain_key, seq[i * bs:(i + 1) * bs],
+                             req.blocks[i])
+            req.chain_key = key
+            req.committed_blocks += 1
+            if req.inflight_keys and req.inflight_keys[0] == key:
+                req.inflight_keys.pop(0)
+                n = self._inflight_keys.get(key, 0) - 1
+                if n <= 0:
+                    self._inflight_keys.pop(key, None)
+                else:
+                    self._inflight_keys[key] = n
+
     def _admit(self, now: float) -> None:
         for req in sorted(self.waiting,
                           key=lambda r: self._policy_key(r, now)):
             free_slots = [i for i, r in enumerate(self.slots) if r is None]
             if not free_slots:
                 return
+            if self._try_prefix_seed(req):
+                continue         # deferred: an admitted twin is prefilling
             min_prefill = self._min_prefill_s(req)
             if (min_prefill is not None
                     and req.remaining_budget(now) < min_prefill):
                 # a guaranteed deadline miss never occupies a slot: expire
                 # at the admission boundary instead of wasting pool space
+                # (a seeded chain is released through the expire path)
                 self.expire(req, reason="budget")
                 continue
             first_chunk = min(len(req.pending), self.prefill_chunk)
             if self.allocator.free_blocks * self.block_size < first_chunk:
+                self._unseed(req)
                 continue         # in-flight admission waits for frees
             self.waiting.remove(req)
             req.slot = free_slots[0]
@@ -563,6 +760,8 @@ class Scheduler:
             req.state = RequestState.PREFILL
             req.was_admitted = True
             self.admissions += 1
+            self._register_inflight(req)
+            self.prefix_tokens_reused += req.num_computed
 
     # -- the per-step contract --------------------------------------------
     def schedule(self, now: Optional[float] = None) -> Optional[StepPlan]:
@@ -587,7 +786,9 @@ class Scheduler:
                 continue                       # preempted back to WAITING
             rows[req.slot] = RowWork(
                 req=req, tokens=req.pending[:t], start_pos=req.num_computed,
-                samples_next=req.num_computed + t == len(req.seq))
+                samples_next=req.num_computed + t == len(req.seq),
+                cow=((req.cow_src, req.cow_dst)
+                     if req.cow_dst is not None else None))
         for i, w in enumerate(rows):
             if w is not None and w.req.slot != i:
                 # a LATER row's allocation preempted this already-planned
@@ -614,6 +815,13 @@ class Scheduler:
             if req.finished or req.slot is None:
                 continue
             req.num_computed += len(work.tokens)
+            if work.cow is not None and req.cow_src is not None:
+                # the COW copy rode this step: the private dst now holds
+                # the shared slots, so the source ref can be released
+                self.allocator.free([req.cow_src])
+                req.cow_src = None
+                req.cow_dst = None
+            self._commit_full(req)
             if not work.samples_next:
                 continue
             tok = int(sampled[req.slot])
@@ -623,6 +831,7 @@ class Scheduler:
             if hit_eos or len(req.out_tokens) >= req.max_new_tokens:
                 self.slots[req.slot] = None
                 req.slot = None
+                self._drop_chain_state(req)
                 if req.blocks:
                     self.allocator.free(req.blocks)
                     req.blocks = []

@@ -142,6 +142,21 @@ Serving-engine points (see ``serving/scheduler.py`` / ``serving/engine.py``):
                       replays the admitted requests from their last
                       computed token (pinned; greedy output stays
                       token-identical through the recovery).
+    kv_prefix_lookup  in ``Scheduler._try_prefix_seed``, before the prefix
+                      index is consulted at admission — a corrupt/unusable
+                      index lookup.  Contract: the request degrades to a
+                      COLD prefill, byte-identical greedy output, no
+                      shared block touched, ``all_free`` after terminal
+                      states — the cache is an optimization, never a
+                      correctness dependency.
+    kv_cow_fork       in ``Scheduler._try_prefix_seed``, at the private-
+                      block grab of a copy-on-write fork — fork allocation
+                      failing on a fully-cached sequence.  Contract: the
+                      acquired chain's refs are returned (the shared
+                      source block is NEVER corrupted or reclaimed out
+                      from under other holders), the request falls back to
+                      a cold prefill token-identically, and the failure is
+                      counted (``cow_fork_failures``).
 
 Serving-fleet points (see ``serving/fleet.py``):
 
@@ -237,6 +252,8 @@ KNOWN_FAULT_POINTS = frozenset({
     "serve_deadline",
     "serve_shed",
     "serve_watchdog_stall",
+    "kv_prefix_lookup",
+    "kv_cow_fork",
     "fleet_route",
     "fleet_replica_loss",
     "fleet_replica_admit",

@@ -185,6 +185,17 @@ SECONDARY = {
     # the serving-under-fire numbers: shed_rate, expired_rate,
     # goodput_fraction and overload_p99_ms (p99 of admitted requests).
     "serve": [],
+    # ``prefix_cache`` — _prefix_cache_secondary_main: generated tokens/s
+    # at high prefix overlap (a block-aligned shared system prompt with
+    # unique short tails — the prompt shape prefix caching exists for)
+    # with content-hash prefix caching ON, with _vs_baseline = cache-on
+    # tok/s / cache-off tok/s on the identical request set.  Greedy
+    # outputs are token-identical either way (the parity oracle is
+    # tier-1; this leg is the wall-clock win).  Extra secondary keys:
+    # prefill_tokens_saved (prompt tokens NOT recomputed in the timed
+    # window) and cache_hit_rate.  ``BENCH_PREFIX=0`` skips the leg
+    # (records null).
+    "prefix_cache": [],
     # ``elastic_serve`` — _elastic_serve_secondary_main: the serving
     # analogue of the elastic drill (docs/guides/serving.md "Elastic
     # fleet").  A seeded arrival trace through a 2-replica FleetRouter
@@ -218,7 +229,10 @@ SECONDARY = {
     # recipe; reports rollout tokens/s through the engine as tps plus the
     # train-vs-rollout wall split (rollout_wall_frac / train_wall_frac /
     # logprob_wall_frac) — the number that says which side of the
-    # interleave to optimize next.
+    # interleave to optimize next.  Also reports the group-level rollout
+    # fork split (rollout_fork_speedup / fork_prefill_tokens_saved): one
+    # identical rollout timed cache-off vs prefix-caching-on, where the G
+    # GRPO group members COW-fork one prompt's committed KV chain.
     "grpo": [],
     # ``rollout_sync`` — _rollout_sync_secondary_main: weight-sync latency
     # (ms per update, mean over a burst) of DecodeEngine.update_params —
@@ -696,14 +710,15 @@ def _elastic_secondary_main() -> None:
 
 
 def _serve_engine(model, params, *, max_num_seqs, max_model_len,
-                  max_new_tokens):
+                  max_new_tokens, prefix_caching=None):
     from automodel_tpu.generation import GenerationConfig
     from automodel_tpu.serving import DecodeEngine, ServingConfig
 
     return DecodeEngine(
         model, params,
         ServingConfig(kv_block_size=16, max_num_seqs=max_num_seqs,
-                      max_model_len=max_model_len, prefill_chunk=32),
+                      max_model_len=max_model_len, prefill_chunk=32,
+                      prefix_caching=prefix_caching),
         generation=GenerationConfig(max_new_tokens=max_new_tokens))
 
 
@@ -751,6 +766,53 @@ def _serve_decode_secondary_main() -> None:
     bN = run(n_req)
     print(json.dumps({"tps": round(bN, 1),
                       "vs_baseline": round(bN / b1, 4)}))
+
+
+def _prefix_cache_secondary_main() -> None:
+    """Child process: decode tokens/s under high prefix overlap, prefix
+    caching on vs off.
+
+    Every request shares a block-aligned 96-token prefix (the system-
+    prompt shape) with a unique short tail; with the cache on the shared
+    blocks prefill once and every later request seeds its table from the
+    committed chain, so only the cold tail touches the chip.  Greedy
+    outputs are token-identical either way (the parity oracle is tier-1;
+    this leg is the speed), so _vs_baseline = cache-on tok/s / cache-off
+    tok/s isolates the prefill work not recomputed.  ``BENCH_PREFIX=0``
+    skips.
+    """
+    if os.environ.get("BENCH_PREFIX", "1") == "0":
+        raise SystemExit("BENCH_PREFIX=0: prefix-cache leg skipped")
+    model, params = _serve_model()
+    n_req, max_new = (8, 8) if SMALL else (16, 16)
+    prefix_len, tail_len = 96, 4   # six full 16-token blocks + cold tail
+    rng = np.random.default_rng(0)
+    shared = [int(t) for t in rng.integers(1, 2000, prefix_len)]
+    prompts = [shared + [int(t) for t in rng.integers(1, 2000, tail_len)]
+               for _ in range(n_req)]
+
+    def run(mode):
+        eng = _serve_engine(model, params, max_num_seqs=8,
+                            max_model_len=prefix_len + tail_len + max_new,
+                            max_new_tokens=max_new, prefix_caching=mode)
+        eng.submit(prompts[0])   # warm both step widths off the clock —
+        eng.run()                # and, cache on, commit the shared chain
+        saved0 = eng.scheduler.prefix_tokens_reused
+        t0 = time.perf_counter()
+        for p in prompts:
+            eng.submit(p)
+        eng.run()
+        dt = time.perf_counter() - t0
+        return (n_req * max_new / dt,
+                eng.scheduler.prefix_tokens_reused - saved0,
+                eng.stats()["cache_hit_rate"])
+
+    tps_off, _, _ = run("off")
+    tps_on, saved, hit_rate = run("on")
+    print(json.dumps({"tps": round(tps_on, 1),
+                      "vs_baseline": round(tps_on / tps_off, 4),
+                      "prefill_tokens_saved": int(saved),
+                      "cache_hit_rate": round(hit_rate, 4)}))
 
 
 def _drive_arrival_trace(eng, prompts, arrivals, *, deadline_s=None,
@@ -1108,6 +1170,39 @@ def _grpo_secondary_main() -> None:
     rollout_s = elapsed.get("rollout", 0.0)
     train_s = elapsed.get("train", 0.0)
     logprob_s = elapsed.get("logprob", 0.0)
+
+    # Group-level rollout fork (docs/guides/serving.md "Prefix caching &
+    # copy-on-write"): one identical rollout each way — the recipe's own
+    # engine (cache off on the mock YAML) vs a second engine with prefix
+    # caching on, where the G group members COW-fork one prompt's
+    # committed chain and a group pays ~1 prefill.  On a one-chip CPU dev
+    # host extra batch rows are nearly free, so the followers' deferral
+    # window (they wait for the leader's blocks to commit) can eat the
+    # tiny mock prompt's saving and the speedup may sit below 1.0;
+    # fork_prefill_tokens_saved is the chip-meaningful number — prefill
+    # work a pod-slice rollout genuinely never runs.
+    import dataclasses
+
+    from automodel_tpu.post_training.rollout import RolloutWorker
+    from automodel_tpu.serving import DecodeEngine
+
+    rc = recipe.rollout_config
+    fork_prompts = recipe._next_prompts()
+    rb_off = recipe.rollout_worker.generate(fork_prompts,
+                                            params=recipe.params)
+    eng_on = DecodeEngine(
+        recipe.model, recipe.params,
+        dataclasses.replace(recipe.serving_config, prefix_caching="on"),
+        generation=recipe.engine.generation,
+        param_sharding=recipe.param_sharding,
+        sample_seed=(rc.seed if rc.seed is not None else recipe.rng.seed),
+        timers=None)
+    worker_on = RolloutWorker(eng_on, rc)
+    worker_on.generate(recipe._next_prompts(), params=recipe.params)  # warm
+    rb_on = worker_on.generate(fork_prompts, params=recipe.params)
+    fork_off_s = rb_off.stats["rollout_s"]
+    fork_on_s = rb_on.stats["rollout_s"]
+
     recipe.teardown()
     print(json.dumps({
         "tps": round(tokens / max(rollout_s, 1e-9), 1),
@@ -1115,6 +1210,9 @@ def _grpo_secondary_main() -> None:
         "train_wall_frac": round(train_s / max(wall, 1e-9), 4),
         "logprob_wall_frac": round(logprob_s / max(wall, 1e-9), 4),
         "grpo_sync_ms": round(1e3 * float(np.mean(syncs)), 3),
+        "rollout_fork_speedup": round(fork_off_s / max(fork_on_s, 1e-9), 4),
+        "fork_prefill_tokens_saved": int(
+            rb_on.stats["prefill_tokens_saved"]),
     }))
 
 
@@ -1181,6 +1279,8 @@ def _secondary_main(name: str) -> None:
         return _serve_decode_secondary_main()
     if name == "serve":
         return _serve_trace_secondary_main()
+    if name == "prefix_cache":
+        return _prefix_cache_secondary_main()
     if name == "elastic_serve":
         return _elastic_serve_secondary_main()
     if name == "grpo":

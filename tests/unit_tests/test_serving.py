@@ -214,6 +214,8 @@ def test_decode_step_census_clean(model_and_params):
                            np.zeros((2, 1), np.int32),
                            np.zeros((2, eng.max_blocks_per_seq), np.int32),
                            np.ones((2,), np.int32),
+                           np.zeros((2,), np.int32),
+                           np.zeros((2,), np.int32),
                            np.zeros((2,), np.int32))
     census = jaxpr_census(jaxpr)
     assert not census.collectives, census.collectives

@@ -914,6 +914,8 @@ def test_lifecycle_states_keep_compile_once_and_census_clean(
                            np.zeros((4, 1), np.int32),
                            np.zeros((4, eng.max_blocks_per_seq), np.int32),
                            np.ones((4,), np.int32),
+                           np.zeros((4,), np.int32),
+                           np.zeros((4,), np.int32),
                            np.zeros((4,), np.int32))
     census = jaxpr_census(jaxpr)
     assert not census.collectives, census.collectives

@@ -138,6 +138,10 @@ def main(argv=None) -> int:
                     help="override serving.kv_cache_dtype (e.g. int8)")
     ap.add_argument("--policy", default=None,
                     help="override serving.scheduler_policy")
+    ap.add_argument("--prefix-cache", default=None, choices=["on", "off"],
+                    dest="prefix_cache",
+                    help="override serving.prefix_caching (content-hash "
+                         "prefix reuse with copy-on-write forks)")
     ap.add_argument("--deadline-s", type=float, default=None,
                     help="per-request end-to-end deadline (None: unbounded)")
     ap.add_argument("--max-queue-s", type=float, default=None,
@@ -187,6 +191,7 @@ def main(argv=None) -> int:
     cfg = load_yaml_config(args.config)
     for flag, dotted in (("kv_dtype", "serving.kv_cache_dtype"),
                          ("policy", "serving.scheduler_policy"),
+                         ("prefix_cache", "serving.prefix_caching"),
                          ("watchdog_s", "serving.watchdog_s"),
                          ("max_waiting", "serving.max_waiting"),
                          ("shed_policy", "serving.shed_policy"),
