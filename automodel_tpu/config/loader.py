@@ -374,6 +374,7 @@ def _enum_fields():
         SCHEDULER_POLICIES,
         SHED_POLICIES,
     )
+    from automodel_tpu.serving.speculative import SPECULATIVE_MODES
     from automodel_tpu.training.pipeline import PP_SCHEDULES
 
     return {
@@ -386,6 +387,7 @@ def _enum_fields():
         "serving.prefix_caching": PREFIX_CACHING_MODES,
         "serving.scheduler_policy": SCHEDULER_POLICIES,
         "serving.shed_policy": SHED_POLICIES,
+        "serving.speculative": SPECULATIVE_MODES,
         "serving.router_policy": ROUTER_POLICIES,
         "pipeline.schedule": PP_SCHEDULES,
         "post_training.algorithm": PT_ALGORITHMS,
@@ -399,11 +401,15 @@ def _enum_normalizers():
     bools must map back onto the mode names before the membership check."""
     from automodel_tpu.ops.kernel_lib.autotune import normalize_autotune_mode
     from automodel_tpu.serving.kv_cache import normalize_prefix_caching
+    from automodel_tpu.serving.speculative import normalize_speculative
 
     return {
         "kernels.autotune": normalize_autotune_mode,
         # ``serving.prefix_caching: on`` is likewise a YAML 1.1 bool
         "serving.prefix_caching": normalize_prefix_caching,
+        # ``serving.speculative: off`` is a YAML 1.1 bool too (and true
+        # means "the default proposer", i.e. ngram)
+        "serving.speculative": normalize_speculative,
     }
 
 
@@ -429,6 +435,9 @@ _POSITIVE_INT_FIELDS = ("pipeline.pp_size", "pipeline.num_microbatches",
                         # prefix-cache warm-LRU bound (a typo'd size must
                         # fail at load, not as silent zero caching)
                         "serving.prefix_lru_blocks",
+                        # speculative draft depth (a typo'd k must fail at
+                        # load, not as a silent zero-draft verify width)
+                        "serving.spec_k",
                         # post-training rollout geometry (a typo'd group
                         # size must fail at load, not as a reshape error in
                         # the advantage normalizer)

@@ -178,9 +178,10 @@ def run_attention_parity(spec_name: str, case: Dict,
 # paged attention family (the serving decode path)
 # ---------------------------------------------------------------------------
 def paged_attention_cases() -> List[Dict]:
-    """Decode (q=1) and chunked-prefill (q>1) traffic over scrambled block
-    tables with ragged per-row context lengths; the int8 cases exercise
-    the quantized-KV dequant inside each rung."""
+    """Decode (q=1), speculative-verify (q=spec_k+1) and chunked-prefill
+    (q>1) traffic over scrambled block tables with ragged per-row context
+    lengths; the int8 cases exercise the quantized-KV dequant inside each
+    rung."""
     return [
         dict(name="decode_gqa", q_seq=1, dtype="float32"),
         dict(name="decode_bf16", q_seq=1, dtype="bfloat16"),
@@ -189,6 +190,11 @@ def paged_attention_cases() -> List[Dict]:
         dict(name="decode_window", q_seq=1, dtype="float32", window=24),
         dict(name="decode_soft_cap", q_seq=1, dtype="float32",
              soft_cap=30.0),
+        dict(name="spec_verify_w3", q_seq=3, dtype="float32"),
+        dict(name="spec_verify_w5_int8_kv", q_seq=5, dtype="float32",
+             quantized=True),
+        dict(name="spec_verify_window", q_seq=3, dtype="float32",
+             window=24),
         dict(name="chunked_prefill", q_seq=8, dtype="float32"),
         dict(name="chunked_prefill_int8_kv", q_seq=8, dtype="float32",
              quantized=True),
@@ -244,8 +250,6 @@ def build_paged_attention_case(case: Dict, *, B=2, Hq=4, Hk=2, D=128,
 def run_paged_attention_parity(spec_name: str, case: Dict) -> None:
     spec = registry.get_kernel(spec_name)
     assert spec.reference is not None, f"{spec_name} has no XLA reference"
-    if spec_name == "attention.paged_decode" and case["q_seq"] != 1:
-        return      # that rung's contract is single-token decode queries
     args, kwargs, request = build_paged_attention_case(case)
     with interpret_mode():
         out = spec.impl(request, *args, **kwargs)

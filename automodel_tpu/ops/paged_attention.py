@@ -10,8 +10,11 @@ layout is its own kernel family on the PR-7 substrate:
 * ``attention.paged_decode`` — Pallas gather-by-block-table online-softmax
   decode (``ops/paged_attention_kernel.py``): the block table rides scalar
   prefetch so BlockSpec index maps DMA exactly the pages a row owns, with
-  wholly-past-the-context pages skipped.  Single-token queries (the decode
-  hot path).
+  wholly-past-the-context pages skipped.  Small queries (the decode hot
+  path at S=1, the speculative verify step at S=spec_k+1, and chunked
+  prefill) — the S query tokens fold into the query-group dim, with
+  per-query causality derived from each row's FIRST position (queries are
+  consecutive by the contract below).
 * ``attention.paged_gather`` — the XLA anchor registered HERE: gather the
   pool by block table, mask by per-token positions + context lengths, SDPA.
   Always available (CPU test path, chunked-prefill queries of any length,

@@ -1,6 +1,6 @@
 """Pallas paged-decode attention — the ``attention.paged_decode`` rung.
 
-Single-token decode over the serving engine's block-paged KV cache
+Small-q decode/verify over the serving engine's block-paged KV cache
 (``ops/paged_attention.py`` owns the family contract).  The per-request
 block tables ride SCALAR PREFETCH, so each grid step's BlockSpec index map
 steers the DMA at exactly the pool page a row owns for that position range
@@ -10,9 +10,18 @@ a flash-style online softmax in VMEM scratch; pages wholly past the row's
 context length are compute-skipped (their DMA fetches the engine's null
 page 0, which every pad table entry points at).
 
-Decode queries are single tokens at position ``context_len - 1``, so the
-causal constraint degenerates to the context-length mask — the kernel
-needs no position operand at all.
+**Chunked q**: the kernel serves any small query length ``S`` — plain
+decode (S=1), the speculative verify step (S=spec_k+1) and chunked
+prefill — by FOLDING the S query tokens into the query-group dim (one
+``(kt, S*G, D) x (kt, BS, D)`` contraction per page; no second grid
+axis, no new schedule).  Per-query causality needs one extra scalar:
+each row's FIRST query position rides prefetch, and query ``s`` masks
+``kv_pos <= pos0 + s`` — valid because the engine writes a row's step
+tokens at CONSECUTIVE positions (the family contract; pad columns repeat
+the last valid position and their outputs are discarded by the caller,
+so the consecutive assumption only over-attends garbage columns).  At
+S=1 the mask degenerates to the classic ``kv_pos < ctx`` decode mask
+bit-exactly.
 
 Quantized (int8) pools dequantize IN VMEM with the per-slot scale planes
 (PR-10's ``quant_cast`` contract inverted), so the HBM traffic — the thing
@@ -43,11 +52,17 @@ _LANE = tiling.LANE
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
+# q lengths the fold-into-groups schedule stays profitable (and VMEM-sane)
+# for: decode (1), speculative verify (spec_k+1) and chunked prefill all
+# sit far below this; longer prefill belongs to the dense-attention path.
+_MAX_CHUNKED_Q = 64
+
+
 def paged_decode_available(q_seq: int, head_dim: int) -> bool:
-    """Kernel path requires single-token queries (the decode contract: the
-    causal mask degenerates to the context mask), a lane-aligned head dim,
+    """Kernel path requires small queries (1 <= S <= 64 — decode, the
+    speculative verify width, chunked prefill), a lane-aligned head dim,
     and TPU (or interpret mode)."""
-    if q_seq != 1 or head_dim % _LANE:
+    if not 1 <= q_seq <= _MAX_CHUNKED_Q or head_dim % _LANE:
         return False
     if _INTERPRET:
         return True
@@ -57,32 +72,35 @@ def paged_decode_available(q_seq: int, head_dim: int) -> bool:
         return False
 
 
-def _tile_bytes(kt: int, g: int, bs: int, d: int, kv_itemsize: int,
+def _tile_bytes(kt: int, ge: int, bs: int, d: int, kv_itemsize: int,
                 quantized: bool) -> int:
     """VMEM working set of one (row, kv-head-tile) grid step: the
     double-buffered k/v page blocks (+ int8 scale planes), the resident q
-    block, and the fp32 online-softmax scratch.  ONE byte model — shared
-    by the runtime default/validate AND the sweep's candidate filter."""
+    block, and the fp32 online-softmax scratch.  ``ge`` is the EFFECTIVE
+    query-group size ``S * G`` — chunked q folds the S query tokens into
+    the group dim, so they scale the q/scratch terms exactly like extra
+    query heads.  ONE byte model — shared by the runtime default/validate
+    AND the sweep's candidate filter."""
     pages = 2 * 2 * bs * kt * d * kv_itemsize          # k+v double-buffered
     if quantized:
         pages += 2 * 2 * bs * kt * 4                   # scale planes
-    q = kt * g * d * 4
-    scratch = kt * g * d * 4 + 2 * kt * g * 128 * 4    # acc + m/l
+    q = kt * ge * d * 4
+    scratch = kt * ge * d * 4 + 2 * kt * ge * 128 * 4  # acc + m/l
     return pages + q + scratch
 
 
-def _head_tile(hk: int, g: int, bs: int, d: int, kv_itemsize: int,
+def _head_tile(hk: int, g: int, s: int, bs: int, d: int, kv_itemsize: int,
                quantized: bool, pages: int, dtype: str) -> int:
     """kv-head tile via divisor search under the VMEM budget, overridden
     by a persisted autotune winner (kernel key ``"paged_decode"``)."""
     budget = tiling.DEFAULT_TILE_BUDGET_BYTES
 
     def fits(kt: int) -> bool:
-        return _tile_bytes(kt, g, bs, d, kv_itemsize, quantized) <= budget
+        return _tile_bytes(kt, s * g, bs, d, kv_itemsize, quantized) <= budget
 
     divisors = [kt for kt in range(hk, 0, -1) if hk % kt == 0]
     default = next((kt for kt in divisors if fits(kt)), 1)
-    fields = {"hk": hk, "g": g, "bs": bs, "d": d,
+    fields = {"hk": hk, "g": g, "s": s, "bs": bs, "d": d,
               "pages": autotune.shape_bucket(pages), "dtype": dtype,
               "quant": quantized}
     choice = autotune.lookup(
@@ -92,13 +110,14 @@ def _head_tile(hk: int, g: int, bs: int, d: int, kv_itemsize: int,
     return int(choice[0])
 
 
-def _decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
-                   o_ref, m_ref, l_ref, acc_ref, *, bs, kt, g, scale,
-                   soft_cap, window, quantized):
+def _decode_kernel(bt_ref, cl_ref, p0_ref, q_ref, k_ref, v_ref, ks_ref,
+                   vs_ref, o_ref, m_ref, l_ref, acc_ref, *, bs, kt, g, s_q,
+                   scale, soft_cap, window, quantized):
     from jax.experimental import pallas as pl
 
     b, j = pl.program_id(0), pl.program_id(2)
     nj = pl.num_programs(2)
+    ge = s_q * g                 # S query tokens folded into the group dim
 
     @pl.when(j == 0)
     def _init():
@@ -116,22 +135,25 @@ def _decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
                 x = x * s_ref[0].astype(jnp.float32)[..., None]
             return jnp.swapaxes(x, 0, 1)            # (kt, BS, D)
 
-        q = q_ref[0].astype(jnp.float32)            # (kt, G, D)
+        q = q_ref[0].astype(jnp.float32)            # (kt, S*G, D)
         k = page(k_ref, ks_ref)
-        # (kt, G, D) x (kt, BS, D) -> (kt, G, BS), kv heads batched
+        # (kt, S*G, D) x (kt, BS, D) -> (kt, S*G, BS), kv heads batched
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale
         if soft_cap is not None:
             s = soft_cap * jnp.tanh(s / soft_cap)
-        kv_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (kt, g, bs), 2)
-        valid = kv_pos < ctx
+        kv_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (kt, ge, bs), 2)
+        # per-query position: row r of the folded dim is query token
+        # r // g at position pos0 + r // g (consecutive-position contract)
+        qpos = p0_ref[b] + jax.lax.broadcasted_iota(
+            jnp.int32, (kt, ge, bs), 1) // g
+        valid = (kv_pos < ctx) & (kv_pos <= qpos)
         if window is not None:
-            # decode query position == ctx - 1
-            valid &= kv_pos > ctx - 1 - window
+            valid &= kv_pos > qpos - window
         s = jnp.where(valid, s, _NEG_INF)
 
-        s2 = s.reshape(kt * g, bs)
+        s2 = s.reshape(kt * ge, bs)
         m_prev = m_ref[:, :1]
         m_b = jnp.max(s2, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_b)
@@ -141,9 +163,9 @@ def _decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
 
         v = page(v_ref, vs_ref)                     # (kt, BS, D)
         o_b = jax.lax.dot_general(
-            p.reshape(kt, g, bs), v, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)     # (kt, G, D)
-        acc_ref[...] = acc_ref[...] * alpha + o_b.reshape(kt * g, -1)
+            p.reshape(kt, ge, bs), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)     # (kt, S*G, D)
+        acc_ref[...] = acc_ref[...] * alpha + o_b.reshape(kt * ge, -1)
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
@@ -156,67 +178,85 @@ def _decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
 
 
 def paged_decode_pallas(q, k_pool, v_pool, k_scale, v_scale, block_tables,
-                        context_lens, *, scale=None, logits_soft_cap=None,
-                        local_window_size=None):
-    """``q [B, 1, Hq, D]`` over position-major pools ``[NB, BS, Hk, D]``
-    (+ optional int8 scale planes ``[NB, BS, Hk]``) -> ``[B, 1, Hq, D]``."""
+                        context_lens, positions=None, *, scale=None,
+                        logits_soft_cap=None, local_window_size=None):
+    """``q [B, S, Hq, D]`` (small S — decode 1, verify spec_k+1, chunked
+    prefill) over position-major pools ``[NB, BS, Hk, D]`` (+ optional
+    int8 scale planes ``[NB, BS, Hk]``) -> ``[B, S, Hq, D]``.
+
+    ``positions [B, S]``: each query token's absolute position.  The
+    kernel prefetches only column 0 and derives the rest as ``pos0 + s``
+    — the engine writes a row's step tokens at consecutive positions (pad
+    columns repeat the last valid position; their outputs are garbage the
+    caller discards).  None (legacy S=1 decode callers) means
+    ``context_lens - 1``."""
     from jax.experimental import pallas as pl
 
     B, S, Hq, D = q.shape
     NB, BS, Hk, _ = k_pool.shape
     MB = block_tables.shape[1]
-    assert S == 1, "paged_decode is the single-token decode rung"
+    assert S <= _MAX_CHUNKED_Q, "paged_decode is the small-q rung"
     G = Hq // Hk
+    GE = S * G                    # S query tokens folded into the group dim
     scale = D ** -0.5 if scale is None else scale
     quantized = k_scale is not None
-    kt = _head_tile(Hk, G, BS, D, k_pool.dtype.itemsize, quantized, MB,
+    kt = _head_tile(Hk, G, S, BS, D, k_pool.dtype.itemsize, quantized, MB,
                     str(q.dtype))
+    if positions is None:
+        assert S == 1, "q_seq > 1 requires explicit positions"
+        pos0 = context_lens.astype(jnp.int32) - 1
+    else:
+        pos0 = positions[:, 0].astype(jnp.int32)
 
-    q4 = q.reshape(B, Hk, G, D)
+    # [B, S, Hq, D] -> [B, S, Hk, G, D] -> [B, Hk, S, G, D] -> fold (S, G)
+    q4 = q.reshape(B, S, Hk, G, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, Hk, GE, D)
     if not quantized:
         # uniform kernel signature: zero-page dummies the specs still index
         k_scale = jnp.ones((1, BS, Hk), jnp.float32)
         v_scale = jnp.ones((1, BS, Hk), jnp.float32)
 
-    def page_index(b, h, j, bt, cl):
+    def page_index(b, h, j, bt, cl, p0):
         return (bt[b, j], 0, h, 0)
 
-    def scale_index(b, h, j, bt, cl):
+    def scale_index(b, h, j, bt, cl, p0):
         if quantized:
             return (bt[b, j], 0, h)
         return (0, 0, h)
 
-    def q_index(b, h, j, bt, cl):
+    def q_index(b, h, j, bt, cl, p0):
         return (b, h, 0, 0)
 
     out = pl.pallas_call(
         functools.partial(
-            _decode_kernel, bs=BS, kt=kt, g=G, scale=scale,
+            _decode_kernel, bs=BS, kt=kt, g=G, s_q=S, scale=scale,
             soft_cap=logits_soft_cap, window=local_window_size,
             quantized=quantized),
         grid_spec=tiling.prefetch_grid_spec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=(B, Hk // kt, MB),
             in_specs=[
-                tiling.block_spec((1, kt, G, D), q_index),
+                tiling.block_spec((1, kt, GE, D), q_index),
                 tiling.block_spec((1, BS, kt, D), page_index),
                 tiling.block_spec((1, BS, kt, D), page_index),
                 tiling.block_spec((1, BS, kt), scale_index),
                 tiling.block_spec((1, BS, kt), scale_index),
             ],
-            out_specs=tiling.block_spec((1, kt, G, D), q_index),
+            out_specs=tiling.block_spec((1, kt, GE, D), q_index),
             scratch_shapes=[
-                _scratch((kt * G, 128), jnp.float32),
-                _scratch((kt * G, 128), jnp.float32),
-                _scratch((kt * G, D), jnp.float32),
+                _scratch((kt * GE, 128), jnp.float32),
+                _scratch((kt * GE, 128), jnp.float32),
+                _scratch((kt * GE, D), jnp.float32),
             ]),
-        out_shape=jax.ShapeDtypeStruct((B, Hk, G, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Hk, GE, D), q.dtype),
         compiler_params=tiling.compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_INTERPRET,
     )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
-      q4, k_pool, v_pool, k_scale, v_scale)
-    return out.reshape(B, 1, Hq, D)
+      pos0, q4, k_pool, v_pool, k_scale, v_scale)
+    # unfold (S, G) and restore [B, S, Hq, D]
+    return out.reshape(B, Hk, S, G, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, S, Hq, D)
 
 
 def _scratch(shape, dtype):
@@ -236,19 +276,20 @@ def _paged_decode_impl(request, q, k_pool, v_pool, k_scale, v_scale,
                        block_tables, context_lens, positions, *,
                        scale=None, logits_soft_cap=None,
                        local_window_size=None):
-    # positions are implied by the decode contract (ctx - 1); the family
-    # entry passes them for the gather rung's benefit.
-    del positions
     return paged_decode_pallas(
         q, k_pool, v_pool, k_scale, v_scale, block_tables, context_lens,
-        scale=scale, logits_soft_cap=logits_soft_cap,
+        positions, scale=scale, logits_soft_cap=logits_soft_cap,
         local_window_size=local_window_size)
 
 
 def _sweep_key_fields(req):
     g = req["num_q_heads"] // req["num_kv_heads"]
-    return {"hk": req["num_kv_heads"], "g": g, "bs": req["block_size"],
-            "d": req["head_dim"],
+    return {"hk": req["num_kv_heads"], "g": g,
+            # q length is a tiling dimension now (it folds into the group
+            # dim): decode (1), the speculative verify width and chunked
+            # prefill each get their own sweep entry
+            "s": int(req.get("q_seq", 1)),
+            "bs": req["block_size"], "d": req["head_dim"],
             "pages": autotune.shape_bucket(req["pages_per_seq"]),
             "dtype": str(req.get("dtype", "bfloat16")),
             "quant": bool(req.get("quantized"))}
@@ -256,23 +297,24 @@ def _sweep_key_fields(req):
 
 def _sweep_candidates(req):
     hk, d, bs = req["num_kv_heads"], req["head_dim"], req["block_size"]
-    g = req["num_q_heads"] // hk
+    ge = (req["num_q_heads"] // hk) * int(req.get("q_seq", 1))
     item = 1 if req.get("quantized") else 2
     return [(kt,) for kt in range(hk, 0, -1)
             if hk % kt == 0
-            and _tile_bytes(kt, g, bs, d, item, bool(req.get("quantized")))
+            and _tile_bytes(kt, ge, bs, d, item, bool(req.get("quantized")))
             <= tiling.DEFAULT_TILE_BUDGET_BYTES]
 
 
 def _sweep_run(req, choice) -> float:
     hk, d, bs = req["num_kv_heads"], req["head_dim"], req["block_size"]
     hq, mb = req["num_q_heads"], req["pages_per_seq"]
+    s = int(req.get("q_seq", 1))
     b = int(req.get("batch", 8))
     nb = b * mb + 1
     quant = bool(req.get("quantized"))
     key = jax.random.key(0)
     dtype = jnp.dtype(req.get("dtype", "bfloat16"))
-    q = jax.random.normal(key, (b, 1, hq, d), jnp.float32).astype(dtype)
+    q = jax.random.normal(key, (b, s, hq, d), jnp.float32).astype(dtype)
     if quant:
         kp = jax.random.randint(key, (nb, bs, hk, d), -127, 128, jnp.int8)
         vp = kp
@@ -285,9 +327,10 @@ def _sweep_run(req, choice) -> float:
         ks = vs = None
     tables = jnp.arange(1, 1 + b * mb, dtype=jnp.int32).reshape(b, mb)
     ctx = jnp.full((b,), mb * bs, jnp.int32)
+    pos = ctx[:, None] - s + jnp.arange(s, dtype=jnp.int32)[None, :]
 
     fn = jax.jit(functools.partial(paged_decode_pallas, scale=None))
-    return autotune.time_call(fn, q, kp, vp, ks, vs, tables, ctx)
+    return autotune.time_call(fn, q, kp, vp, ks, vs, tables, ctx, pos)
 
 
 registry.register_kernel(

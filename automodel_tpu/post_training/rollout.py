@@ -219,6 +219,10 @@ class RolloutWorker:
         # members hit one prompt's chain, so a group pays ~1 prefill —
         # report the tokens THIS rollout did not recompute
         saved0 = eng.scheduler.prefix_tokens_reused
+        spec_prop0 = eng.scheduler.spec_tokens_proposed
+        spec_acc0 = eng.scheduler.spec_tokens_accepted
+        appended0 = eng.scheduler.tokens_appended
+        steps0 = eng.steps_run
         t0 = time.perf_counter()
         rids: List[int] = []
         try:
@@ -283,6 +287,17 @@ class RolloutWorker:
                     / max(1, eng.prefix_index.lookups)
                     if getattr(eng, "prefix_index", None) is not None
                     else 0.0),
+                # speculative decoding, deltas for THIS rollout (greedy
+                # recipes only — do_sample rollouts report zeros)
+                "spec_tokens_accepted": float(
+                    eng.scheduler.spec_tokens_accepted - spec_acc0),
+                "accept_rate": (
+                    (eng.scheduler.spec_tokens_accepted - spec_acc0)
+                    / max(1, eng.scheduler.spec_tokens_proposed
+                          - spec_prop0)),
+                "tokens_per_step": (
+                    (eng.scheduler.tokens_appended - appended0)
+                    / max(1, eng.steps_run - steps0)),
             })
         return batch
 

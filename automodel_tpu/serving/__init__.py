@@ -8,9 +8,13 @@ Layout::
                     pytree + the content-hash PrefixIndex (shared blocks,
                     copy-on-write forks)
       scheduler.py  per-request state machine, chunked prefill, preemption,
-                    deadlines/TTLs, admission control, the pin breaker
+                    deadlines/TTLs, admission control, the pin breaker,
+                    speculative draft acceptance
       engine.py     static-shape jitted steps + the host decode loop,
                     watchdog recovery + graceful drain
+      speculative.py
+                    draft proposers (prompt-lookup n-gram) + the greedy
+                    acceptance rule for the width-(spec_k+1) verify step
       fleet.py      elastic replica fleet: routing, fleet-level shed,
                     replica loss -> cross-replica replay, grow-back from
                     live peer params
@@ -44,4 +48,10 @@ from automodel_tpu.serving.scheduler import (       # noqa: F401
     RequestRejected,
     RequestState,
     Scheduler,
+)
+from automodel_tpu.serving.speculative import (     # noqa: F401
+    DEFAULT_SPEC_K,
+    SPECULATIVE_MODES,
+    NgramProposer,
+    propose_ngram,
 )

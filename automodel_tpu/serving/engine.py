@@ -42,11 +42,23 @@ the scheduler (``serving/scheduler.py`` docstring), and the engine adds
   rows EXPIRE with blocks reclaimed).  ``tools/serve.py`` wires it to
   SIGTERM/SIGINT mirroring the trainer's preemption grace window.
 
-Greedy sampling runs on-device inside the step (one ``[B]`` token fetch
-per step is the engine's only host sync); ``do_sample`` configs sample
-host-side from the returned last-token logits.  Greedy output is
+Greedy sampling runs on-device inside the step (one ``[B, W]`` token
+fetch per step is the engine's only host sync); ``do_sample`` configs
+sample host-side from the returned last-token logits.  Greedy output is
 token-identical to ``generate()`` on the same model/params — the tier-1
 parity oracle (``tests/unit_tests/test_serving.py``).
+
+Speculative decoding (``serving.speculative: ngram``,
+``serving/speculative.py``) changes only the pure-decode width: a
+host-side prompt-lookup proposer drafts up to ``serving.spec_k`` tokens
+per sampling row, the step runs once at width ``spec_k + 1`` (token +
+drafts written together, argmax read at every position), and the
+scheduler accepts the longest draft prefix matching the greedy chain
+plus the bonus token.  Compiled widths become ``{spec_k+1,
+prefill_chunk}`` — acceptance churn is data, never a shape — and the
+per-step host sync stays ONE fetch, now ``[B, spec_k+1]`` ints.  Greedy
+output is token-identical to spec-off by construction (tier-1 pinned,
+``tests/unit_tests/test_speculative.py``).
 """
 
 from __future__ import annotations
@@ -93,6 +105,13 @@ from automodel_tpu.serving.scheduler import (
     validate_scheduler_policy,
     validate_shed_policy,
 )
+from automodel_tpu.serving.speculative import (
+    DEFAULT_SPEC_K,
+    DEFAULT_SPECULATIVE,
+    build_proposer,
+    normalize_speculative,
+    validate_speculative,
+)
 from automodel_tpu.utils.fault_injection import InjectedFault, fault_point
 
 logger = logging.getLogger(__name__)
@@ -125,6 +144,9 @@ class ServingConfig:
     sjf_aging_steps: Optional[int] = None    # None -> default (32)
     watchdog_s: Optional[float] = None       # None -> watchdog disabled
     drain_grace_s: Optional[float] = None    # None -> unbounded drain
+    # -- speculative decoding (docs/guides/serving.md "Speculative") -------
+    speculative: Optional[str] = None        # None -> off (off/ngram, bools ok)
+    spec_k: Optional[int] = None             # None -> default (4) draft tokens
     # -- elastic fleet (docs/guides/serving.md "Elastic fleet") ------------
     replicas: Optional[int] = None           # None -> 1 (single engine)
     router_policy: Optional[str] = None      # None -> round_robin
@@ -145,7 +167,7 @@ class ServingConfig:
 
         for field in ("max_waiting", "max_preemptions", "sjf_aging_steps",
                       "replicas", "fleet_probation_polls",
-                      "prefix_lru_blocks"):
+                      "prefix_lru_blocks", "spec_k"):
             v = normalize_null_spelling(getattr(self, field))
             setattr(self, field, v)
             if v is None:
@@ -168,6 +190,8 @@ class ServingConfig:
             normalize_kv_cache_dtype(self.kv_cache_dtype))
         self.prefix_caching = validate_prefix_caching(
             normalize_prefix_caching(self.prefix_caching))
+        self.speculative = validate_speculative(
+            normalize_speculative(self.speculative))
         self.scheduler_policy = validate_scheduler_policy(
             normalize_scheduler_policy(self.scheduler_policy))
         self.shed_policy = validate_shed_policy(
@@ -217,9 +241,12 @@ def _paged_step(model, block_size: int, quantized: bool, cow_enabled: bool,
                 context_lens, last_col, cow_src, cow_dst):
     """ONE traced program per step width: run any pending copy-on-write
     block forks, write this step's tokens into the paged cache, attend,
-    and greedy-pick each row's next token at its last valid column.
-    Returns ``(greedy [B], last_logits [B, V], pools)`` — pools donated,
-    so the cache updates in place.
+    and greedy-pick EVERY column's next token.  Returns ``(greedy [B, W],
+    last_logits [B, V], pools)`` — pools donated, so the cache updates in
+    place.  Plain decode reads its one token at its last valid column of
+    ``greedy``; the speculative verify reads the argmax at each draft
+    position from the same array — the per-column argmax IS the verify,
+    so acceptance costs no extra device work and no extra fetch.
 
     ``cow_src``/``cow_dst`` are fixed ``[B]`` block-id pairs: rows with a
     prefix-cache fork copy their shared last block into a private one
@@ -239,7 +266,7 @@ def _paged_step(model, block_size: int, quantized: bool, cow_enabled: bool,
     logits = out["logits"].astype(jnp.float32)                # [B, W, V]
     last = jnp.take_along_axis(
         logits, last_col[:, None, None], axis=1)[:, 0]        # [B, V]
-    greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)    # [B, W]
     return greedy, last, out["kv_cache"]
 
 
@@ -286,6 +313,19 @@ class DecodeEngine:
             self.prefix_index = PrefixIndex(
                 self.allocator, block_size=self.config.kv_block_size,
                 lru_blocks=self.config.prefix_lru_blocks)
+        # -- speculative decoding (serving/speculative.py) -----------------
+        spec_mode = self.config.speculative or DEFAULT_SPECULATIVE
+        self.spec_k = self.config.spec_k or DEFAULT_SPEC_K
+        if spec_mode != "off" and self.generation.do_sample:
+            # acceptance verifies the GREEDY chain; a host-sampled token
+            # has no draft to verify against, so speculation is a no-op
+            # under do_sample — disable it loudly rather than silently
+            # paying the wide verify step for nothing
+            logger.warning(
+                "serving.speculative=%s disabled: generation.do_sample is "
+                "set and speculative verification is greedy-only", spec_mode)
+            spec_mode = "off"
+        self.spec_mode = spec_mode
         self.scheduler = Scheduler(
             self.allocator, max_num_seqs=self.config.max_num_seqs,
             prefill_chunk=self.config.prefill_chunk,
@@ -299,6 +339,8 @@ class DecodeEngine:
             sjf_aging_steps=self.config.sjf_aging_steps
             or DEFAULT_SJF_AGING_STEPS,
             prefix_index=self.prefix_index,
+            spec_proposer=build_proposer(spec_mode),
+            spec_k=self.spec_k,
             clock=clock)
         self.requests: Dict[int, Request] = {}
         self.rejections: List[RequestRejected] = []
@@ -480,9 +522,16 @@ class DecodeEngine:
         cow_src = np.zeros((B,), np.int32)
         cow_dst = np.zeros((B,), np.int32)
         for work in plan.active:
-            b, t = work.req.slot, len(work.tokens)
+            b = work.req.slot
+            # draft tokens are ordinary written tokens to the device step:
+            # same ids/pos/slot treatment, context covers them, and the
+            # per-column argmax at their positions is the verify readout.
+            # Only the HOST distinguishes pending from draft (acceptance
+            # advances num_computed past accepted drafts only).
+            toks = list(work.tokens) + list(work.draft)
+            t = len(toks)
             start = work.start_pos
-            ids[b, :t] = work.tokens
+            ids[b, :t] = toks
             pos[b, :t] = np.arange(start, start + t)
             pos[b, t:] = start + t - 1      # pads clamp to the last valid
             blocks = work.req.blocks
@@ -495,11 +544,9 @@ class DecodeEngine:
                 cow_src[b], cow_dst[b] = work.cow
         return ids, pos, slots, tables, ctx, last, cow_src, cow_dst
 
-    def _sample(self, row: int, greedy: np.ndarray,
-                last_logits) -> np.ndarray:
-        if not self.generation.do_sample:
-            return greedy[row]
-        # host-side sampling path: one extra [V] fetch per sampled row
+    def _sample(self, row: int, last_logits) -> int:
+        # host-side sampling path (do_sample only — greedy rows read the
+        # in-step argmax): one extra [V] fetch per sampled row
         key = jax.random.fold_in(self._sample_key, self.steps_run * 4096
                                  + row)
         return int(np.asarray(sample_logits(
@@ -590,9 +637,11 @@ class DecodeEngine:
             greedy, last_logits, self.pools = self.step_fn(plan.step_width)(
                 self.params, self.pools, ids, pos, slots, tables, ctx, last,
                 cow_src, cow_dst)
-            # the engine's one host sync: the [B] sampled tokens drive the
-            # host-side request state machine
-            greedy = np.asarray(jax.device_get(greedy))  # lint: disable=L004 (continuous batching IS a per-step host decision loop: one [B]-int fetch per step, the logits stay on device unless do_sample)
+            # the engine's one host sync: the [B, W] per-column argmax
+            # drives the host-side request state machine — plain decode
+            # reads one column, the speculative verify reads k+1, SAME
+            # fetch either way
+            greedy = np.asarray(jax.device_get(greedy))  # lint: disable=L004 (continuous batching IS a per-step host decision loop: one [B, W]-int fetch per step — the speculative verify rides it too — and the logits stay on device unless do_sample)
         except InjectedFault:
             self._watchdog_recover("injected stall (serve_watchdog_stall)")
             return []
@@ -603,15 +652,29 @@ class DecodeEngine:
             # real bug stays loud
             self._watchdog_recover(f"device step failed: {e!r}")
             raise
-        sampled = {w.req.slot: self._sample(w.req.slot, greedy, last_logits)
-                   for w in plan.active if w.samples_next}
+        # slot -> this row's greedy/sampled CHAIN: column t-1 is the plain
+        # next token, columns t..t+d-1 are the argmax at the d draft
+        # positions (the verify read — finish_step accepts the longest
+        # matching prefix).  do_sample rows (never drafted) sample host-side.
+        sampled = {}
+        for w in plan.active:
+            if not w.samples_next:
+                continue
+            b, t = w.req.slot, len(w.tokens)
+            if self.generation.do_sample:
+                sampled[b] = [self._sample(b, last_logits)]
+            else:
+                sampled[b] = greedy[b, t - 1:t + len(w.draft)].tolist()
         self.steps_run += 1
-        if plan.step_width == 1:
+        # a decode step carries no prefill work — under speculation its
+        # width is spec_k+1, so classify by the rows, not the width
+        if all(len(w.tokens) == 1 for w in plan.active):
             self.decode_steps += 1
         else:
             self.mixed_steps += 1
+        appended0 = self.scheduler.tokens_appended
         done = self.scheduler.finish_step(plan, sampled)
-        self.tokens_generated += len(sampled)
+        self.tokens_generated += self.scheduler.tokens_appended - appended0
         now = self.clock()
         self.scheduler.note_step_time(now - t0)
         self._no_progress_since = None               # this step progressed
@@ -751,11 +814,26 @@ class DecodeEngine:
             "cow_fork_failures": sched.cow_fork_failures,
             "deferrals": sched.prefix_deferrals,
         }
+        spec = {
+            "enabled": self.spec_mode != "off",
+            "mode": self.spec_mode,
+            "spec_k": self.spec_k,
+            "tokens_proposed": sched.spec_tokens_proposed,
+            "tokens_accepted": sched.spec_tokens_accepted,
+            "draft_faults": sched.spec_draft_faults,
+            "verify_failures": sched.spec_verify_failures,
+        }
         return {
             "prefill_tokens_saved": sched.prefix_tokens_reused,
             "cache_hit_rate": (idx.hits / max(1, idx.lookups)
                                if idx else 0.0),
             "prefix_cache": prefix,
+            "spec_tokens_accepted": sched.spec_tokens_accepted,
+            "accept_rate": (sched.spec_tokens_accepted
+                            / max(1, sched.spec_tokens_proposed)),
+            "tokens_per_step": (self.tokens_generated
+                                / max(1, self.steps_run)),
+            "speculative": spec,
             "steps": self.steps_run,
             "decode_steps": self.decode_steps,
             "mixed_steps": self.mixed_steps,

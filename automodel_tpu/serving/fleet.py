@@ -589,9 +589,22 @@ class FleetRouter:
                     "kv_blocks_free": r.engine.allocator.free_blocks,
                     "prefill_tokens_saved":
                         r.engine.scheduler.prefix_tokens_reused,
+                    "spec_tokens_accepted":
+                        r.engine.scheduler.spec_tokens_accepted,
                 } for r in self.replicas},
             "prefill_tokens_saved": sum(
                 r.engine.scheduler.prefix_tokens_reused
                 for r in self.replicas),
+            "spec_tokens_proposed": sum(
+                r.engine.scheduler.spec_tokens_proposed
+                for r in self.replicas),
+            "spec_tokens_accepted": sum(
+                r.engine.scheduler.spec_tokens_accepted
+                for r in self.replicas),
+            "accept_rate": (
+                sum(r.engine.scheduler.spec_tokens_accepted
+                    for r in self.replicas)
+                / max(1, sum(r.engine.scheduler.spec_tokens_proposed
+                             for r in self.replicas))),
             "outcomes": self.outcome_counts(),
         }

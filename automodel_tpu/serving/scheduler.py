@@ -67,6 +67,22 @@ Scheduling policies (``serving.scheduler_policy``):
 * ``sjf``  — shortest pending work first with aging (above): better p50
   under mixed lengths without the textbook starvation failure.
 
+Speculative decoding (``serving.speculative: ngram``): on pure-decode
+steps a host-side proposer (``serving/speculative.py``) drafts up to
+``spec_k`` tokens per sampling row from the row's own prompt+generated
+history; the engine writes pending token + drafts in ONE step at width
+``spec_k + 1`` and hands :meth:`finish_step` the greedy argmax at EVERY
+written position.  The longest draft prefix matching that chain is
+accepted plus the bonus token — token-identical to plain greedy by
+construction.  The pending invariant absorbs it because acceptance
+advances ``num_computed`` past exactly the accepted draft tokens (they
+are already in the KV cache); the bonus token is appended but NOT
+counted computed, so it is the next step's pending token like any plain
+decode.  Rejected draft positions sit past ``num_computed`` in private
+(never committed, never shared) blocks — dead until overwritten.  Block
+commit runs BEFORE acceptance on a ``num_computed`` that excludes every
+draft, so an unaccepted token can never enter the prefix index.
+
 Prefix caching (``serving.prefix_caching: on``): admission consults the
 :class:`~automodel_tpu.serving.kv_cache.PrefixIndex` — a hit seeds the
 request's block table with shared block ids and starts ``num_computed``
@@ -96,6 +112,7 @@ from automodel_tpu.serving.kv_cache import (
     PrefixIndex,
     blocks_needed,
 )
+from automodel_tpu.serving.speculative import DEFAULT_SPEC_K
 from automodel_tpu.utils.fault_injection import InjectedFault, fault_point
 
 # ``serving.scheduler_policy`` config domain (enum-validated at config
@@ -249,12 +266,20 @@ class RowWork:
     # (src, dst) whole-block COW copy the step must run BEFORE this row's
     # writes; None for the common no-fork case
     cow: Optional[tuple] = None
+    # speculative draft tokens written (and verified) AFTER ``tokens`` at
+    # positions start_pos+len(tokens).. — deliberately NOT part of
+    # ``tokens``: drafts are a guess about the future, never pending work,
+    # and ``num_computed`` only ever advances past the accepted ones
+    draft: List[int] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
 class StepPlan:
     rows: List[Optional[RowWork]]      # len == max_num_seqs, None = idle
-    step_width: int                    # 1 (pure decode) or prefill_chunk
+    # 1 (pure decode), spec_k+1 (pure decode, speculation on — ALWAYS,
+    # even when every draft came back empty: draft length is data, not
+    # shape) or prefill_chunk (any row still prefilling)
+    step_width: int
 
     @property
     def active(self) -> List[RowWork]:
@@ -272,6 +297,8 @@ class Scheduler:
                  max_preemptions: Optional[int] = None,
                  sjf_aging_steps: int = DEFAULT_SJF_AGING_STEPS,
                  prefix_index: Optional[PrefixIndex] = None,
+                 spec_proposer: Optional[Callable] = None,
+                 spec_k: int = DEFAULT_SPEC_K,
                  clock: Callable[[], float] = time.monotonic):
         policy = validate_scheduler_policy(normalize_scheduler_policy(policy))
         shed_policy = validate_shed_policy(
@@ -309,6 +336,24 @@ class Scheduler:
         # chain key -> count of admitted requests about to commit it (the
         # deferral signal for concurrent identical prompts)
         self._inflight_keys: Dict[str, int] = {}
+        # -- speculative decoding (serving/speculative.py) ----------------
+        # proposer None == off; pure-decode steps then keep width 1 and
+        # every spec branch below is dead code (spec-off bit-unchanged)
+        self.spec_proposer = spec_proposer
+        self.spec_k = spec_k
+        self._spec_width = (spec_k + 1) if spec_proposer is not None else 1
+        self.spec_tokens_proposed = 0    # drafts that reached a verify step
+        self.spec_tokens_accepted = 0
+        self.spec_draft_faults = 0
+        self.spec_verify_failures = 0
+        self.tokens_appended = 0         # out_tokens grown, all rows
+        # Accepted-tokens-per-sampling-row EWMA: the admission budget
+        # guard prices prefill in STEPS, and speculation makes one step
+        # worth >1 token — dividing the priced step count by this keeps
+        # admission from spuriously rejecting under speculation.  Spec-off
+        # every sampling row appends exactly one token, so the EWMA stays
+        # exactly 1.0 and the guard's arithmetic is bit-unchanged.
+        self._tokens_per_row_ewma = 1.0
 
     # -- intake ------------------------------------------------------------
     def add(self, req: Request) -> List[RequestRejected]:
@@ -586,7 +631,12 @@ class Scheduler:
         if self._step_time_ewma is None:
             return None
         steps = blocks_needed(len(req.pending), self.prefill_chunk)
-        return steps * self._step_time_ewma
+        # normalize by accepted-tokens-per-row: under speculation the EWMA
+        # step cost is a WIDE (spec_k+1) step worth >1 token of progress,
+        # so pricing prefill at the raw step cost would overcharge and
+        # spuriously expire admissible requests.  Spec-off the divisor is
+        # exactly 1.0 (x / 1.0 is bitwise x — behavior unchanged).
+        return steps * self._step_time_ewma / self._tokens_per_row_ewma
 
     def _expire_due(self, now: float) -> None:
         """The step-boundary deadline sweep (active AND waiting rows),
@@ -763,6 +813,29 @@ class Scheduler:
             self._register_inflight(req)
             self.prefix_tokens_reused += req.num_computed
 
+    # -- speculative decoding ----------------------------------------------
+    def _propose_draft(self, req: Request, k_max: int) -> List[int]:
+        """Host-side draft proposal for one sampling DECODE row: at most
+        ``min(spec_k, k_max, tokens-the-request-can-still-emit - 1)``
+        tokens from the proposer (the ``- 1`` reserves the bonus token, and
+        also bounds every draft's write position below ``prompt +
+        max_new_tokens <= max_model_len``).  Stateless: recompute replay,
+        watchdog rebuild and fleet adoption re-draft from ``req.seq``
+        alone, so there is no draft state to flush or migrate."""
+        k_cap = min(self.spec_k, k_max,
+                    req.max_new_tokens - len(req.out_tokens) - 1)
+        if k_cap <= 0:
+            return []
+        # The drilled proposer-failure site: an armed ``spec_draft``
+        # degrades THIS row to plain decode for the step (empty draft,
+        # same verify width) — byte-identical output, just no speedup.
+        try:
+            fault_point("spec_draft")
+        except InjectedFault:
+            self.spec_draft_faults += 1
+            return []
+        return [int(t) for t in self.spec_proposer(req.seq, k_cap)][:k_cap]
+
     # -- the per-step contract --------------------------------------------
     def schedule(self, now: Optional[float] = None) -> Optional[StepPlan]:
         """Expire what ran out of time, admit what fits, grow block tables
@@ -775,18 +848,27 @@ class Scheduler:
         self._admit(now)
         if not self.active:
             return None
-        width = self.prefill_chunk if any(
-            len(r.pending) > 1 for r in self.active) else 1
+        # Pure-decode steps run at the SPEC width whenever speculation is
+        # on (spec_k+1; 1 when off) — even for rows whose proposer came
+        # back empty — so acceptance/rejection/draft-length churn is data
+        # inside one compiled program, never a new shape.
+        any_prefill = any(len(r.pending) > 1 for r in self.active)
+        width = self.prefill_chunk if any_prefill else self._spec_width
+        speculate = self.spec_proposer is not None and not any_prefill
         rows: List[Optional[RowWork]] = [None] * self.max_num_seqs
         for req in list(self.active):
             if req.slot is None:
                 continue       # preempted by an earlier row's allocation
             t = min(len(req.pending), width)
-            if not self._ensure_blocks(req, req.num_computed + t):
+            samples_next = req.num_computed + t == len(req.seq)
+            draft = (self._propose_draft(req, width - t)
+                     if speculate and samples_next else [])
+            if not self._ensure_blocks(req, req.num_computed + t
+                                       + len(draft)):
                 continue                       # preempted back to WAITING
             rows[req.slot] = RowWork(
                 req=req, tokens=req.pending[:t], start_pos=req.num_computed,
-                samples_next=req.num_computed + t == len(req.seq),
+                samples_next=samples_next, draft=draft,
                 cow=((req.cow_src, req.cow_dst)
                      if req.cow_dst is not None else None))
         for i, w in enumerate(rows):
@@ -801,15 +883,38 @@ class Scheduler:
         return StepPlan(rows=rows, step_width=width)
 
     def finish_step(self, plan: StepPlan,
-                    sampled: Dict[int, int]) -> List[Request]:
+                    sampled: Dict[int, Sequence[int]]) -> List[Request]:
         """Apply one executed plan: advance ``num_computed``, append the
-        sampled token where the pending list emptied, retire finished
-        requests (freeing their blocks).  ``sampled`` maps slot -> token.
-        Rows whose request reached a terminal state mid-step (an abort or
-        watchdog expiry issued between ``schedule()`` and here) are
-        skipped — their blocks were already reclaimed and their replay
-        state must not be advanced by stale device results."""
+        sampled tokens where the pending list emptied, retire finished
+        requests (freeing their blocks).  ``sampled`` maps slot -> the
+        row's greedy/sampled chain: entry 0 is the token after the last
+        pending token (plain decode's one sample); entries ``1..d`` are
+        the argmax AT the row's ``d`` draft positions — the verify read.
+        The longest draft prefix matching the chain is accepted, plus the
+        bonus token after it; ``num_computed`` advances past accepted
+        drafts ONLY (their KV is valid), never the bonus token and never
+        a rejected position — rejected slots are dead KV past the
+        high-water mark, overwritten by whatever comes next.  Rows whose
+        request reached a terminal state mid-step (an abort or watchdog
+        expiry issued between ``schedule()`` and here) are skipped —
+        their blocks were already reclaimed and their replay state must
+        not be advanced by stale device results."""
         done: List[Request] = []
+        # The drilled verify-failure site: an armed ``spec_verify`` models
+        # the whole verify step's draft results being unusable — EVERY
+        # draft this step is discarded with no partial acceptance (m=0),
+        # each sampling row keeps only its plain-decode token (chain[0],
+        # valid regardless of drafts), and KV state is clean because
+        # nothing past ``num_computed`` is ever committed or shared.
+        verify_failed = False
+        if any(w.draft for w in plan.active):
+            try:
+                fault_point("spec_verify")
+            except InjectedFault:
+                verify_failed = True
+                self.spec_verify_failures += 1
+        sampling_rows = 0
+        appended_total = 0
         for work in plan.active:
             req = work.req
             if req.finished or req.slot is None:
@@ -821,14 +926,46 @@ class Scheduler:
                 self.allocator.free([req.cow_src])
                 req.cow_src = None
                 req.cow_dst = None
+            # Commit BEFORE acceptance: ``num_computed`` here covers no
+            # draft token, so an unaccepted draft can never reach the
+            # prefix index even transiently (accepted ones commit next
+            # step, once they are provably part of the sequence).
             self._commit_full(req)
             if not work.samples_next:
                 continue
-            tok = int(sampled[req.slot])
-            req.out_tokens.append(tok)
-            hit_eos = (req.eos_token_id is not None
-                       and tok == req.eos_token_id)
-            if hit_eos or len(req.out_tokens) >= req.max_new_tokens:
+            raw = sampled[req.slot]
+            # a bare int is the no-draft chain of one (plain decode
+            # callers — and the pre-speculation contract — pass scalars)
+            chain = ([int(t) for t in raw]
+                     if isinstance(raw, (list, tuple)) else [int(raw)])
+            m = 0
+            if work.draft:
+                self.spec_tokens_proposed += len(work.draft)
+                if not verify_failed:
+                    while (m < len(work.draft)
+                           and work.draft[m] == chain[m]):
+                        m += 1
+                self.spec_tokens_accepted += m
+            appended = 0
+            finish_reason = None
+            for tok in chain[:m + 1]:
+                req.out_tokens.append(tok)
+                appended += 1
+                if (req.eos_token_id is not None
+                        and tok == req.eos_token_id):
+                    finish_reason = "eos"
+                    break
+                if len(req.out_tokens) >= req.max_new_tokens:
+                    finish_reason = "length"
+                    break
+            # accepted drafts already sit in the KV cache; the bonus token
+            # (position m in the chain) does not — it is next step's
+            # pending token, exactly like plain decode's sampled token
+            req.num_computed += min(appended, m)
+            sampling_rows += 1
+            appended_total += appended
+            self.tokens_appended += appended
+            if finish_reason is not None:
                 self.slots[req.slot] = None
                 req.slot = None
                 self._drop_chain_state(req)
@@ -836,9 +973,16 @@ class Scheduler:
                     self.allocator.free(req.blocks)
                     req.blocks = []
                 req.state = RequestState.FINISHED
-                req.finish_reason = "eos" if hit_eos else "length"
+                req.finish_reason = finish_reason
                 req.finish_time = self.clock()
                 done.append(req)
             else:
                 req.state = RequestState.DECODE
+        if sampling_rows:
+            # the admission guard's tokens-per-row EWMA (see __init__):
+            # spec-off the mean is exactly 1.0 every update, so the EWMA
+            # is the constant 1.0 and the guard is bit-unchanged
+            mean = appended_total / sampling_rows
+            self._tokens_per_row_ewma = (
+                0.5 * self._tokens_per_row_ewma + 0.5 * mean)
         return done

@@ -157,6 +157,24 @@ Serving-engine points (see ``serving/scheduler.py`` / ``serving/engine.py``):
                       from under other holders), the request falls back to
                       a cold prefill token-identically, and the failure is
                       counted (``cow_fork_failures``).
+    spec_draft        in ``Scheduler._propose_draft``, before the
+                      speculative proposer runs — the draft source failing
+                      for one row.  Contract: THAT row rides the verify
+                      step with an empty draft (plain decode, byte-
+                      identical greedy output, just no speedup), every
+                      other row's drafts are unaffected, and the failure
+                      is counted (``spec_draft_faults``).
+    spec_verify       in ``Scheduler.finish_step``, before draft
+                      acceptance on a step that carried any draft — the
+                      verify results being unusable.  Contract: every
+                      draft of the step is DISCARDED with no partial
+                      acceptance (each sampling row keeps only its plain-
+                      decode token, which is valid independent of drafts),
+                      KV state stays clean (nothing past ``num_computed``
+                      is ever committed or shared, so rejected positions
+                      are dead slots), greedy output stays token-
+                      identical, and the failure is counted
+                      (``spec_verify_failures``).
 
 Serving-fleet points (see ``serving/fleet.py``):
 
@@ -254,6 +272,8 @@ KNOWN_FAULT_POINTS = frozenset({
     "serve_watchdog_stall",
     "kv_prefix_lookup",
     "kv_cow_fork",
+    "spec_draft",
+    "spec_verify",
     "fleet_route",
     "fleet_replica_loss",
     "fleet_replica_admit",

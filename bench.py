@@ -196,6 +196,19 @@ SECONDARY = {
     # window) and cache_hit_rate.  ``BENCH_PREFIX=0`` skips the leg
     # (records null).
     "prefix_cache": [],
+    # ``speculative`` — _speculative_secondary_main: generated tokens/s
+    # with n-gram speculative decoding ON over a HIGH-REPETITION request
+    # set (periodic prompts — the traffic prompt-lookup drafting wins
+    # on), with _vs_baseline = spec-on tok/s / spec-off tok/s on the
+    # identical requests.  Greedy outputs are token-identical either way
+    # (the parity oracle is tier-1; this leg is the steps-per-token win).
+    # Extra secondary keys: accept_rate, tokens_per_step, and
+    # spec_adversarial_vs_baseline — the same ratio on an all-distinct-
+    # token ADVERSARIAL set where drafting mostly proposes nothing, i.e.
+    # the wider verify program's overhead when speculation buys nothing.
+    # ``BENCH_SPEC=0`` skips the leg (records null); ``BENCH_SPEC_K``
+    # sets the draft depth (default 4).
+    "speculative": [],
     # ``elastic_serve`` — _elastic_serve_secondary_main: the serving
     # analogue of the elastic drill (docs/guides/serving.md "Elastic
     # fleet").  A seeded arrival trace through a 2-replica FleetRouter
@@ -710,7 +723,8 @@ def _elastic_secondary_main() -> None:
 
 
 def _serve_engine(model, params, *, max_num_seqs, max_model_len,
-                  max_new_tokens, prefix_caching=None):
+                  max_new_tokens, prefix_caching=None, speculative=None,
+                  spec_k=None):
     from automodel_tpu.generation import GenerationConfig
     from automodel_tpu.serving import DecodeEngine, ServingConfig
 
@@ -718,7 +732,8 @@ def _serve_engine(model, params, *, max_num_seqs, max_model_len,
         model, params,
         ServingConfig(kv_block_size=16, max_num_seqs=max_num_seqs,
                       max_model_len=max_model_len, prefill_chunk=32,
-                      prefix_caching=prefix_caching),
+                      prefix_caching=prefix_caching,
+                      speculative=speculative, spec_k=spec_k),
         generation=GenerationConfig(max_new_tokens=max_new_tokens))
 
 
@@ -813,6 +828,64 @@ def _prefix_cache_secondary_main() -> None:
                       "vs_baseline": round(tps_on / tps_off, 4),
                       "prefill_tokens_saved": int(saved),
                       "cache_hit_rate": round(hit_rate, 4)}))
+
+
+def _speculative_secondary_main() -> None:
+    """Child process: decode tokens/s with n-gram speculative decoding on
+    vs off, on a high-acceptance trace and an adversarial one.
+
+    The high-repetition set is periodic prompts (a motif tiled out), so
+    prompt-lookup drafting proposes the continuation the greedy model
+    actually emits and most steps accept several tokens — the trace the
+    feature exists for (code, templated text, self-repeating decode
+    loops).  The adversarial set is all-distinct-token prompts: the
+    trailing n-gram has no prior occurrence, drafts are mostly empty, and
+    the ratio prices the wider verify program when speculation buys
+    nothing.  Greedy outputs are token-identical in all four runs (the
+    parity oracle is tier-1; this leg is the wall-clock).  ``BENCH_SPEC=0``
+    skips; ``BENCH_SPEC_K`` sets draft depth (default 4).
+    """
+    if os.environ.get("BENCH_SPEC", "1") == "0":
+        raise SystemExit("BENCH_SPEC=0: speculative leg skipped")
+    model, params = _serve_model()
+    spec_k = int(os.environ.get("BENCH_SPEC_K", "4"))
+    n_req, max_new = (8, 16) if SMALL else (16, 48)
+    prompt_len = 24
+    rng = np.random.default_rng(0)
+    motif = [int(t) for t in rng.integers(1, 2000, 6)]
+    rep_prompts = [(motif * ((prompt_len // 6) + 1))[:prompt_len]
+                   for _ in range(n_req)]
+    adv_prompts = [[int(t) for t in
+                    rng.permutation(np.arange(1, 2000))[:prompt_len]]
+                   for _ in range(n_req)]
+
+    def run(prompts, mode):
+        eng = _serve_engine(model, params, max_num_seqs=8,
+                            max_model_len=prompt_len + max_new,
+                            max_new_tokens=max_new,
+                            speculative=mode, spec_k=spec_k)
+        eng.submit(prompts[0])     # warm both step widths off the clock
+        eng.run()
+        t0 = time.perf_counter()
+        for p in prompts:
+            eng.submit(p)
+        out = eng.run()
+        dt = time.perf_counter() - t0
+        return n_req * max_new / dt, eng.stats(), out
+
+    tps_off, _, out_off = run(rep_prompts, "off")
+    tps_on, s, out_on = run(rep_prompts, "ngram")
+    assert out_on == out_off, "speculative decode diverged from greedy"
+    adv_off, _, a_off = run(adv_prompts, "off")
+    adv_on, _, a_on = run(adv_prompts, "ngram")
+    assert a_on == a_off, "speculative decode diverged on adversarial set"
+    print(json.dumps({
+        "tps": round(tps_on, 1),
+        "vs_baseline": round(tps_on / tps_off, 4),
+        "accept_rate": round(s["accept_rate"], 4),
+        "tokens_per_step": round(s["tokens_per_step"], 4),
+        "spec_adversarial_vs_baseline": round(adv_on / adv_off, 4),
+    }))
 
 
 def _drive_arrival_trace(eng, prompts, arrivals, *, deadline_s=None,
@@ -1203,6 +1276,36 @@ def _grpo_secondary_main() -> None:
     fork_off_s = rb_off.stats["rollout_s"]
     fork_on_s = rb_on.stats["rollout_s"]
 
+    # Speculative rollout split (docs/guides/serving.md "Speculative
+    # decoding"): one identical GREEDY rollout spec-off vs spec-on.
+    # Sampled GRPO groups disable speculation (verification is
+    # greedy-only), so the pair runs at temperature 0 — the number is
+    # what n-gram drafting buys the greedy rollout/eval traffic (DPO
+    # scoring, greedy online eval) riding the same engine.  On a one-chip
+    # CPU dev host the width-(spec_k+1) verify step pays real COMPUTE per
+    # extra column, so the ratio can sit below 1.0 here; on a
+    # bandwidth-bound chip the wider step is nearly free and
+    # rollout_spec_accept_rate is the fraction of it that turns into pure
+    # speedup (the ``speculative`` leg's vs_baseline is the wall-clock
+    # anchor).
+    from automodel_tpu.generation import GenerationConfig
+
+    def greedy_rollout(mode):
+        eng = DecodeEngine(
+            recipe.model, recipe.params,
+            dataclasses.replace(recipe.serving_config, speculative=mode),
+            generation=GenerationConfig(max_new_tokens=rc.max_new_tokens,
+                                        eos_token_id=rc.eos_token_id,
+                                        pad_token_id=rc.pad_token_id),
+            param_sharding=recipe.param_sharding, timers=None)
+        worker = RolloutWorker(eng, rc)
+        worker.generate(recipe._next_prompts(), params=recipe.params)  # warm
+        return worker.generate(fork_prompts, params=recipe.params)
+
+    rb_spec_off = greedy_rollout("off")
+    rb_spec_on = greedy_rollout("ngram")
+    assert rb_spec_on.completions == rb_spec_off.completions
+
     recipe.teardown()
     print(json.dumps({
         "tps": round(tokens / max(rollout_s, 1e-9), 1),
@@ -1213,6 +1316,11 @@ def _grpo_secondary_main() -> None:
         "rollout_fork_speedup": round(fork_off_s / max(fork_on_s, 1e-9), 4),
         "fork_prefill_tokens_saved": int(
             rb_on.stats["prefill_tokens_saved"]),
+        "rollout_spec_speedup": round(
+            rb_spec_off.stats["rollout_s"]
+            / max(rb_spec_on.stats["rollout_s"], 1e-9), 4),
+        "rollout_spec_accept_rate": round(
+            rb_spec_on.stats["accept_rate"], 4),
     }))
 
 
@@ -1281,6 +1389,8 @@ def _secondary_main(name: str) -> None:
         return _serve_trace_secondary_main()
     if name == "prefix_cache":
         return _prefix_cache_secondary_main()
+    if name == "speculative":
+        return _speculative_secondary_main()
     if name == "elastic_serve":
         return _elastic_serve_secondary_main()
     if name == "grpo":

@@ -559,7 +559,8 @@ def test_paged_decode_kernel_parity(case):
 def test_paged_chain_and_cpu_fallback(model_and_params):
     """Chain shape + the CPU probe contract: off-TPU, the engine's traffic
     resolves to the gather anchor; in interpret mode the Pallas rung
-    accepts single-token decode requests."""
+    accepts small-q requests (decode, speculative verify, chunked
+    prefill) up to its chunked-q bound and nothing past it."""
     from automodel_tpu.ops import paged_attention_kernel as pak
     from automodel_tpu.ops.kernel_lib import registry
 
@@ -571,12 +572,18 @@ def test_paged_chain_and_cpu_fallback(model_and_params):
     old = pak._INTERPRET
     pak._INTERPRET = True
     try:
-        assert registry.resolve("attention.paged_decode", req).name \
-            == "attention.paged_decode"
-        # chunked prefill never takes the decode rung
+        # decode, spec-verify and chunked-prefill widths all take the
+        # chunked-q rung (the S tokens fold into the query-group dim)
+        for s in (1, 5, 8, pak._MAX_CHUNKED_Q):
+            assert registry.resolve(
+                "attention.paged_decode",
+                {"q_seq": s, "head_dim": 128, "quantized": False},
+            ).name == "attention.paged_decode"
+        # past the chunked-q bound the gather anchor takes over
         assert registry.resolve(
             "attention.paged_decode",
-            {"q_seq": 8, "head_dim": 128, "quantized": False},
+            {"q_seq": pak._MAX_CHUNKED_Q + 1, "head_dim": 128,
+             "quantized": False},
         ).name == "attention.paged_gather"
     finally:
         pak._INTERPRET = old

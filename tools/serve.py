@@ -142,6 +142,13 @@ def main(argv=None) -> int:
                     dest="prefix_cache",
                     help="override serving.prefix_caching (content-hash "
                          "prefix reuse with copy-on-write forks)")
+    ap.add_argument("--speculative", default=None, choices=["off", "ngram"],
+                    help="override serving.speculative (n-gram draft + "
+                         "width-(spec_k+1) verify; greedy output stays "
+                         "token-identical to off)")
+    ap.add_argument("--spec-k", type=int, default=None, dest="spec_k",
+                    help="override serving.spec_k (draft tokens per decode "
+                         "row; verify width is spec_k+1)")
     ap.add_argument("--deadline-s", type=float, default=None,
                     help="per-request end-to-end deadline (None: unbounded)")
     ap.add_argument("--max-queue-s", type=float, default=None,
@@ -192,6 +199,8 @@ def main(argv=None) -> int:
     for flag, dotted in (("kv_dtype", "serving.kv_cache_dtype"),
                          ("policy", "serving.scheduler_policy"),
                          ("prefix_cache", "serving.prefix_caching"),
+                         ("speculative", "serving.speculative"),
+                         ("spec_k", "serving.spec_k"),
                          ("watchdog_s", "serving.watchdog_s"),
                          ("max_waiting", "serving.max_waiting"),
                          ("shed_policy", "serving.shed_policy"),
