@@ -438,6 +438,12 @@ _POSITIVE_INT_FIELDS = ("pipeline.pp_size", "pipeline.num_microbatches",
                         # speculative draft depth (a typo'd k must fail at
                         # load, not as a silent zero-draft verify width)
                         "serving.spec_k",
+                        # multi-tenant adapter geometry (a typo'd slot
+                        # count/rank must fail at load, not as a slab-shape
+                        # error in the grouped GEMM; quota 0 would silently
+                        # starve every tenant — null disables the cap)
+                        "serving.max_adapters", "serving.adapter_rank",
+                        "serving.tenant_quota",
                         # post-training rollout geometry (a typo'd group
                         # size must fail at load, not as a reshape error in
                         # the advantage normalizer)

@@ -370,10 +370,11 @@ class LlamaForCausalLM:
         return rms_norm(x, p["weight"], eps)
 
     def _make_proj(self, adapters, adapter_scale, adapter_dropout,
-                   dropout_position, dropout_rng):
+                   dropout_position, dropout_rng, adapter_ids=None):
         """Projection closure shared by every decoder-layer variant:
         int8 weight-only dequant, quantized-compute routing, rank-r LoRA
-        bypass, optional bias."""
+        bypass (single-adapter or grouped multi-tenant slabs), optional
+        bias."""
         cd = self.compute_dtype
 
         def proj(x, w, name):
@@ -385,7 +386,20 @@ class LlamaForCausalLM:
             else:
                 kern = kern.astype(cd)
             y = maybe_qdot(x, kern, self.quant, name)
-            if adapters is not None and name in adapters:
+            if adapters is not None and name in adapters \
+                    and adapters[name]["A"].ndim == 3:
+                # Multi-tenant serving: per-layer slabs A [E, in, r] /
+                # B [E, r, out] with each batch row routed to its own
+                # adapter slot by ``adapter_ids`` (slot 0 = base = zeros).
+                # Grouped rank-r GEMM through the gmm substrate — see
+                # ``ops/lora_gmm.py``.
+                from automodel_tpu.ops.lora_gmm import multi_lora_delta
+
+                ab = adapters[name]
+                delta = multi_lora_delta(
+                    x, ab["A"].astype(cd), ab["B"].astype(cd), adapter_ids)
+                y = y + jnp.asarray(adapter_scale, cd) * delta
+            elif adapters is not None and name in adapters:
                 # Rank-r LoRA bypass: y += s * (x@A)@B — never materializes
                 # the merged [in, out] kernel (reference Triton path intent,
                 # ``_peft/lora.py:67-214``, done the XLA way).
@@ -473,13 +487,15 @@ class LlamaForCausalLM:
                        attention_mask, inv_freq, adapters=None,
                        adapter_scale=1.0, adapter_dropout=0.0,
                        dropout_position="post", dropout_rng=None,
-                       kv_cache=None, cache_index=None, rope_scale=1.0):
+                       kv_cache=None, cache_index=None, rope_scale=1.0,
+                       adapter_ids=None):
         cfg = self.config
         B, S, H = hidden.shape
         D, Hq, Hk = cfg.head_dim, cfg.num_attention_heads, cfg.num_key_value_heads
         p = layer_params
         proj = self._make_proj(adapters, adapter_scale, adapter_dropout,
-                               dropout_position, dropout_rng)
+                               dropout_position, dropout_rng,
+                               adapter_ids=adapter_ids)
 
         # Attention block
         resid = hidden
@@ -541,6 +557,7 @@ class LlamaForCausalLM:
         dropout_rng: Optional[jax.Array] = None,
         kv_cache: Optional[Dict[str, jnp.ndarray]] = None,
         cache_index: Optional[jnp.ndarray] = None,
+        adapter_ids: Optional[jnp.ndarray] = None,
     ) -> Dict[str, jnp.ndarray]:
         """Forward pass. Returns ``{"logits": ...}`` or, with ``return_hidden``,
         ``{"hidden_states": ..., "lm_head_kernel": ...}`` for fused linear CE
@@ -549,7 +566,10 @@ class LlamaForCausalLM:
         ``adapters``: rank-r LoRA bypass weights, keyed by in-layer module
         path (``"self_attn.q_proj"``) with layer-stacked ``{"A": [L, in, r],
         "B": [L, r, out]}`` values — they ride the layer scan next to the
-        base params (see ``automodel_tpu/peft/lora.py``).
+        base params (see ``automodel_tpu/peft/lora.py``).  Multi-tenant
+        serving instead stacks slot slabs ``{"A": [L, E, in, r], "B":
+        [L, E, r, out]}`` and routes each batch row via ``adapter_ids``
+        (``[B]`` int32, 0 = base model) — see ``serving/adapters.py``.
 
         ``kv_cache``/``cache_index``: autoregressive decode (see
         ``automodel_tpu/generation``) — the result carries the updated cache
@@ -558,6 +578,9 @@ class LlamaForCausalLM:
         if self._embedding_scale != 1.0:
             hidden = hidden * jnp.asarray(self._embedding_scale,
                                           self.compute_dtype)
+        # adapter_ids only reaches forward_embeds when armed — subclasses
+        # that override it (deepseek_v3) don't take the kwarg.
+        extra = {} if adapter_ids is None else {"adapter_ids": adapter_ids}
         return self.forward_embeds(
             params, hidden, position_ids=position_ids,
             segment_ids=segment_ids, attention_mask=attention_mask,
@@ -565,7 +588,7 @@ class LlamaForCausalLM:
             adapter_scale=adapter_scale, adapter_dropout=adapter_dropout,
             adapter_dropout_position=adapter_dropout_position,
             dropout_rng=dropout_rng, kv_cache=kv_cache,
-            cache_index=cache_index)
+            cache_index=cache_index, **extra)
 
     def init_kv_cache(self, batch: int, max_len: int,
                       dtype: Optional[Any] = None) -> Dict[str, jnp.ndarray]:
@@ -591,6 +614,7 @@ class LlamaForCausalLM:
         dropout_rng: Optional[jax.Array] = None,
         kv_cache: Optional[Dict[str, jnp.ndarray]] = None,
         cache_index: Optional[jnp.ndarray] = None,
+        adapter_ids: Optional[jnp.ndarray] = None,
     ) -> Dict[str, jnp.ndarray]:
         """Forward from input embeddings — the VLM path (image features
         already merged into the token stream)."""
@@ -629,13 +653,17 @@ class LlamaForCausalLM:
                 cache = paged_view.layer_view(cache)
             rng = (jax.random.fold_in(dropout_rng, idx)
                    if dropout_rng is not None else None)
+            # Grouped multi-LoRA routing only exists on models whose
+            # _decoder_layer takes adapter_ids; subclasses that override it
+            # (olmo2, phi4_mm) never see the kwarg unless it's armed.
+            extra = {} if adapter_ids is None else {"adapter_ids": adapter_ids}
             h, new_cache, aux = self._decoder_layer(
                 h, layer_params, position_ids, segment_ids, attention_mask,
                 inv_freq, adapters=ad, adapter_scale=adapter_scale,
                 adapter_dropout=adapter_dropout,
                 dropout_position=adapter_dropout_position, dropout_rng=rng,
                 kv_cache=cache, cache_index=cache_index,
-                rope_scale=rope_scale,
+                rope_scale=rope_scale, **extra,
             )
             return h, (new_cache, aux)
 

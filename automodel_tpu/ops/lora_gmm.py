@@ -1,0 +1,68 @@
+"""Grouped multi-LoRA adapter GEMM on the ``gmm`` substrate.
+
+Multi-tenant serving (``docs/guides/serving.md`` "Multi-tenant serving")
+batches requests that each carry their own rank-r LoRA adapter over ONE
+shared base model.  The per-projection adapter delta is
+
+    delta[row] = (x[row] @ A[g]) @ B[g],    g = adapter_ids[row]
+
+which is exactly the MoE dispatch shape: rows group by adapter id the way
+tokens group by expert.  :func:`multi_lora_delta` therefore sorts the
+step's token rows by adapter id and runs the two rank-r matmuls through
+the PR-4 ``gmm`` chain (``gmm.pallas -> gmm.xla_blocked -> gmm.ragged`` —
+every call is a registry dispatch, so it runs under ``JAX_PLATFORMS=cpu``
+tier-1 and autotunes under the existing ``"gmm"`` key).  Like ``tgmm``,
+this is not a registry family of its own: it is only reachable through
+``gmm``, whose parity tests execute all three rungs; the dense
+:func:`multi_lora_delta_reference` below is the per-row XLA oracle the
+multi-LoRA tier-1 tests pin against.
+
+Layout contract (see ``peft/lora.py`` / ``serving/adapters.py``): the
+caller passes PER-LAYER slabs ``A [E, in, r]`` / ``B [E, r, out]`` —
+slot 0 is the base model (all-zero rows, so ``adapter_id == 0`` tokens
+contribute an exactly-zero delta and the base path needs no masking).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from automodel_tpu.ops.gmm_kernel import gmm
+
+
+def multi_lora_delta(x: jnp.ndarray, a_slab: jnp.ndarray,
+                     b_slab: jnp.ndarray,
+                     adapter_ids: jnp.ndarray) -> jnp.ndarray:
+    """Per-request grouped adapter delta for one projection.
+
+    ``x`` ``[B, S, in]`` (every token of row ``b`` belongs to adapter
+    ``adapter_ids[b]``), ``a_slab`` ``[E, in, r]``, ``b_slab``
+    ``[E, r, out]``, ``adapter_ids`` ``[B]`` int32 in ``[0, E)``.
+    Returns ``[B, S, out]`` with ``delta[b, s] = (x[b, s] @ A[g]) @ B[g]``.
+
+    The sort/unsort is a pair of gathers by a static-shape permutation —
+    pure data movement inside the one compiled step, no new program
+    shapes, no collectives, no callbacks (the decode-step census pin).
+    """
+    B, S, fin = x.shape
+    E = a_slab.shape[0]
+    fout = b_slab.shape[-1]
+    rows = x.reshape(B * S, fin)
+    ids = jnp.repeat(adapter_ids.astype(jnp.int32), S)
+    order = jnp.argsort(ids)
+    inv = jnp.argsort(order)
+    group_sizes = jnp.bincount(ids, length=E).astype(jnp.int32)
+    h = gmm(rows[order], a_slab, group_sizes)        # [B*S, r]
+    d = gmm(h, b_slab, group_sizes)                  # [B*S, out]
+    return d[inv].reshape(B, S, fout)
+
+
+def multi_lora_delta_reference(x: jnp.ndarray, a_slab: jnp.ndarray,
+                               b_slab: jnp.ndarray,
+                               adapter_ids: jnp.ndarray) -> jnp.ndarray:
+    """Dense per-row oracle: gather each row's own (A, B) and matmul —
+    O(B*S) rank-r matmuls, parity-harness only."""
+    a = a_slab[adapter_ids]                          # [B, in, r]
+    b = b_slab[adapter_ids]                          # [B, r, out]
+    return jnp.einsum("bsi,bir,bro->bso", x, a, b,
+                      preferred_element_type=jnp.float32).astype(x.dtype)

@@ -105,6 +105,31 @@ def match_targets(model, config: PeftConfig) -> Dict[str, Tuple[Tuple[str, ...],
     return out
 
 
+def adapter_slab_shapes(model, config: PeftConfig,
+                        num_slots: int) -> Dict[str, Tuple[tuple, tuple]]:
+    """{module path: ((L, E, in, r), (L, E, r, out))} — the stacked
+    multi-tenant slot layout of ``serving/adapters.py`` (E = ``num_slots``,
+    slot 0 reserved for the zero/base adapter).  The per-slot geometry is
+    exactly ``LoRAModel._lora_shapes`` with a slot axis spliced after L, so
+    a trained single-adapter tree drops into any slot unchanged.  Only
+    layer-stacked (L, in, out) kernels can ride the serving layer scan —
+    models with unstacked targets cannot host adapter slabs."""
+    abstract = model.abstract_params()
+    flat = _flatten(abstract)
+    r = config.dim
+    shapes: Dict[str, Tuple[tuple, tuple]] = {}
+    for mod_path, (tree_path, _axes) in sorted(
+            match_targets(model, config).items()):
+        kshape = flat[tree_path].shape
+        if len(kshape) != 3:
+            raise ValueError(
+                f"multi-adapter slabs need layer-stacked (L, in, out) "
+                f"kernels; {mod_path} has shape {kshape}")
+        L, fin, fout = kshape
+        shapes[mod_path] = ((L, num_slots, fin, r), (L, num_slots, r, fout))
+    return shapes
+
+
 class LoRAModel:
     """Functional wrapper: delegates everything to the base model after
     merging LoRA deltas into the targeted kernels."""

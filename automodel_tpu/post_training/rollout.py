@@ -201,12 +201,13 @@ class RolloutWorker:
 
     # -- generation --------------------------------------------------------
     def generate(self, prompts: Sequence[Sequence[int]],
-                 params=None) -> RolloutBatch:
+                 params=None, adapter_id: int = 0) -> RolloutBatch:
         """``group_size`` sampled completions per prompt.  With ``params``
         the weight handoff runs first (the live-params contract); the
         engine's sampled stream stays deterministic under its seeded key
         (distinct rows/steps fold distinct constants, so group members
-        diverge)."""
+        diverge).  ``adapter_id`` rolls the batch out under one tenant's
+        adapter slot on a multi-tenant engine (0 = base model)."""
         cfg = self.config
         if params is not None:
             self.sync_weights(params)
@@ -223,6 +224,8 @@ class RolloutWorker:
         spec_acc0 = eng.scheduler.spec_tokens_accepted
         appended0 = eng.scheduler.tokens_appended
         steps0 = eng.steps_run
+        tenant_tokens0 = {tid: d["tokens"]
+                          for tid, d in eng.scheduler.per_tenant.items()}
         t0 = time.perf_counter()
         rids: List[int] = []
         try:
@@ -230,7 +233,8 @@ class RolloutWorker:
                 for _ in range(cfg.group_size):
                     rids.append(eng.submit(
                         p, max_new_tokens=cfg.max_new_tokens,
-                        eos_token_id=cfg.eos_token_id))
+                        eos_token_id=cfg.eos_token_id,
+                        adapter_id=adapter_id))
             # a generous stall bound, like engine.run(): a scheduler wedge
             # must become a typed abort, never a hang
             budget = 64 + 8 * sum(
@@ -298,6 +302,13 @@ class RolloutWorker:
                 "tokens_per_step": (
                     (eng.scheduler.tokens_appended - appended0)
                     / max(1, eng.steps_run - steps0)),
+                # multi-tenant serving: tokens THIS rollout generated per
+                # adapter id (one entry, {adapter_id: tokens}, for the
+                # common single-tenant rollout)
+                "per_tenant_tokens": {
+                    tid: float(d["tokens"] - tenant_tokens0.get(tid, 0))
+                    for tid, d in eng.scheduler.per_tenant.items()
+                    if d["tokens"] - tenant_tokens0.get(tid, 0)},
             })
         return batch
 

@@ -18,12 +18,20 @@ Layout::
       fleet.py      elastic replica fleet: routing, fleet-level shed,
                     replica loss -> cross-replica replay, grow-back from
                     live peer params
+      adapters.py   multi-tenant LoRA slot registry: stacked device slabs,
+                    digest-verified hot-swap, per-request adapter routing
+                    through the grouped GEMM (``ops/lora_gmm.py``)
       eval.py       online-eval consumer (greedy scoring via the engine)
 
 The paged attention kernels live on the PR-7 substrate in
 ``ops/paged_attention.py`` / ``ops/paged_attention_kernel.py``.
 """
 
+from automodel_tpu.serving.adapters import (        # noqa: F401
+    DEFAULT_ADAPTER_RANK,
+    AdapterLoadError,
+    AdapterSlots,
+)
 from automodel_tpu.serving.engine import (          # noqa: F401
     DecodeEngine,
     ServingConfig,

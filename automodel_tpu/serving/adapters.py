@@ -1,0 +1,219 @@
+"""Multi-tenant adapter slot registry — the "which weights" side of
+multi-LoRA serving.
+
+One :class:`AdapterSlots` holds, per targeted projection, a pair of
+device slabs stacked over E = ``max_adapters + 1`` slots::
+
+    A: [L, E, in, r]      B: [L, E, r, out]
+
+Slot 0 is the base model and is permanently all-zero — a request with
+``adapter_id == 0`` contributes an exactly-zero delta through the grouped
+GEMM (``ops/lora_gmm.py``), so base traffic needs no masking and is
+bit-identical to an adapter-free engine.  Slots 1..max_adapters are
+hot-swappable tenants.
+
+Hot-swap contract (``engine.load_adapter`` / ``update_params``): a load
+is digest-verified through the PR-11 replication shard protocol
+(``serialize_tree`` -> sha256-checked ``_rebuild_tree`` round trip — the
+same integrity currency fleet admission uses), geometry-checked against
+the model's :func:`~automodel_tpu.peft.lora.adapter_slab_shapes`, and
+committed ATOMICALLY: all new slab arrays are built first, the registry
+flips last.  Any failure (drilled by the ``adapter_load`` /
+``adapter_swap`` fault points) raises :class:`AdapterLoadError` and
+leaves every slab byte-untouched — the slot keeps serving its old
+adapter and in-flight rows on other slots never notice.  Swapping writes
+``slab.at[:, slot].set(...)``: shapes never change, so the compiled step
+is reused (compile-once pinned) and no program shape is added.
+
+The per-adapter LoRA ``scale`` (alpha/r) is folded into the B slab rows
+at load time, so the model runs every slot at ``adapter_scale=1.0`` and
+tenants with different alphas coexist in one batch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.checkpoint.replication import _rebuild_tree, serialize_tree
+from automodel_tpu.peft.lora import PeftConfig, adapter_slab_shapes
+from automodel_tpu.utils.fault_injection import InjectedFault, fault_point
+
+
+# serving.adapter_rank default — matches PeftConfig.dim's default so a
+# train-with-defaults adapter drops straight into a serve-with-defaults slot
+DEFAULT_ADAPTER_RANK = 8
+
+
+class AdapterLoadError(RuntimeError):
+    """A slot load/swap failed verification; the slot's previous adapter
+    (or the zero adapter) is still serving."""
+
+
+class AdapterSlots:
+    """Host-side slot registry + device slabs for batched multi-LoRA."""
+
+    def __init__(self, model, *, max_adapters: int, rank: int,
+                 target_modules=None):
+        import inspect
+
+        try:
+            # Subclasses inherit __call__ (whose signature advertises the
+            # kwarg) while overriding forward_embeds / _decoder_layer
+            # without it — every hop of the routed path must take it.
+            supports = all(
+                "adapter_ids" in inspect.signature(fn).parameters
+                for fn in (model.__call__, model.forward_embeds,
+                           model._decoder_layer)
+            ) and "adapters" in inspect.signature(model.__call__).parameters
+        except (TypeError, ValueError, AttributeError):
+            supports = False
+        if not supports:
+            raise ValueError(
+                f"{type(model).__name__} does not support grouped adapter "
+                "serving (needs the rank-r bypass forward with an "
+                "`adapter_ids` kwarg)")
+        self.max_adapters = int(max_adapters)
+        self.rank = int(rank)
+        self.num_slots = self.max_adapters + 1      # slot 0 = base
+        cfg = PeftConfig(dim=self.rank)
+        if target_modules is not None:
+            cfg = PeftConfig(dim=self.rank,
+                             target_modules=list(target_modules))
+        self._shapes = adapter_slab_shapes(model, cfg, self.num_slots)
+        self._dtype = model.compute_dtype
+        self.slabs: Dict[str, Dict[str, jnp.ndarray]] = {
+            path: {"A": jnp.zeros(a_shape, self._dtype),
+                   "B": jnp.zeros(b_shape, self._dtype)}
+            for path, (a_shape, b_shape) in self._shapes.items()}
+        # slot -> {"name", "digest", "scale", "version"}
+        self._registry: Dict[int, Dict[str, Any]] = {}
+        self.loads = 0
+        self.swaps = 0
+        self.load_failures = 0
+
+    # -- queries -----------------------------------------------------------
+    def is_loaded(self, adapter_id: int) -> bool:
+        """Slot 0 (base) always serves; others only once loaded."""
+        return adapter_id == 0 or adapter_id in self._registry
+
+    def loaded_slots(self) -> Dict[int, Dict[str, Any]]:
+        return {k: dict(v) for k, v in sorted(self._registry.items())}
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "max_adapters": self.max_adapters,
+            "rank": self.rank,
+            "loaded": sorted(self._registry),
+            "loads": self.loads,
+            "swaps": self.swaps,
+            "load_failures": self.load_failures,
+            "slots": self.loaded_slots(),
+        }
+
+    # -- mutation ----------------------------------------------------------
+    def _check_slot(self, slot: int) -> None:
+        if not (1 <= int(slot) <= self.max_adapters):
+            raise AdapterLoadError(
+                f"adapter slot {slot} out of range [1, {self.max_adapters}] "
+                "(slot 0 is reserved for the base model)")
+
+    def load(self, slot: int, adapters: Dict[str, Any], *,
+             name: Optional[str] = None, scale: float = 1.0) -> Dict[str, Any]:
+        """Load (or hot-swap) one tenant's adapter tree into ``slot``.
+
+        ``adapters`` is a trained single-adapter LoRA tree —
+        ``{module_path: {"A": [L, in, r], "B": [L, r, out]}}``, i.e. the
+        value of ``params["lora"]`` from ``peft/lora.py`` training.
+        Returns the new registry entry.  Raises :class:`AdapterLoadError`
+        on ANY failure, with all slabs untouched."""
+        self._check_slot(slot)
+        swap = slot in self._registry
+        try:
+            if swap:
+                fault_point("adapter_swap")
+            else:
+                fault_point("adapter_load")
+            # Digest-verified transport round trip (PR-11 shard protocol):
+            # serialize to sha256-stamped host shards, rebuild with
+            # verify=True — corruption between trainer and engine fails
+            # loudly here, before any slab is written.
+            host = jax.tree.map(
+                lambda a: np.asarray(jax.device_get(a)), adapters)  # lint: disable=L004 (a load/swap is a control-plane op between batches — the shard digest is computed host-side by design, never inside the step loop)
+            shards = serialize_tree(host)
+            host = _rebuild_tree(host, shards, verify=True)
+            got = set(host) if isinstance(host, dict) else set()
+            want = set(self._shapes)
+            if got != want:
+                raise AdapterLoadError(
+                    f"adapter tree targets {sorted(got)} but this engine "
+                    f"serves slabs for {sorted(want)}")
+            new_slabs: Dict[str, Dict[str, jnp.ndarray]] = {}
+            for path, (a_shape, b_shape) in self._shapes.items():
+                A = np.asarray(host[path]["A"])
+                B = np.asarray(host[path]["B"])
+                want_a = (a_shape[0],) + a_shape[2:]     # (L, in, r)
+                want_b = (b_shape[0],) + b_shape[2:]     # (L, r, out)
+                if A.shape != want_a or B.shape != want_b:
+                    raise AdapterLoadError(
+                        f"{path}: adapter is A{A.shape}/B{B.shape}, slot "
+                        f"geometry is A{want_a}/B{want_b} (uniform rank "
+                        f"r={self.rank} across slots)")
+                # fold the tenant's alpha/r scale into B so the model runs
+                # every slot at adapter_scale=1.0
+                new_slabs[path] = {
+                    "A": self.slabs[path]["A"].at[:, slot].set(
+                        jnp.asarray(A, self._dtype)),
+                    "B": self.slabs[path]["B"].at[:, slot].set(
+                        jnp.asarray(B * float(scale), self._dtype)),
+                }
+        except AdapterLoadError:
+            self.load_failures += 1
+            raise
+        except (InjectedFault, KeyError, ValueError, TypeError) as e:
+            self.load_failures += 1
+            raise AdapterLoadError(
+                f"adapter {'swap' if swap else 'load'} into slot {slot} "
+                f"failed: {e}") from e
+        # Commit: flip every slab reference at once — a failure above left
+        # self.slabs untouched and the registry unchanged.
+        self.slabs = new_slabs
+        digest = hashlib.sha256(
+            "".join(d for d, *_ in
+                    (shards[k] for k in sorted(shards))).encode("ascii")
+        ).hexdigest()
+        entry = {"name": name or f"adapter-{slot}", "digest": digest,
+                 "scale": float(scale),
+                 "version": self._registry.get(slot, {}).get("version", 0) + 1}
+        self._registry[slot] = entry
+        if swap:
+            self.swaps += 1
+        else:
+            self.loads += 1
+        return dict(entry)
+
+    def remove(self, slot: int) -> None:
+        """Zero a slot's rows and forget its registry entry — subsequent
+        requests naming it are rejected at submit."""
+        self._check_slot(slot)
+        if slot not in self._registry:
+            raise AdapterLoadError(f"adapter slot {slot} is not loaded")
+        self.slabs = {
+            path: {"A": s["A"].at[:, slot].set(0.0),
+                   "B": s["B"].at[:, slot].set(0.0)}
+            for path, s in self.slabs.items()}
+        del self._registry[slot]
+
+    def clone_from(self, other: "AdapterSlots") -> None:
+        """Adopt a peer's slabs + registry (fleet replica admission —
+        the admitted engine must serve the same tenants as its warm
+        source)."""
+        if self._shapes != other._shapes:
+            raise AdapterLoadError(
+                "peer adapter slabs have different geometry")
+        self.slabs = {path: dict(s) for path, s in other.slabs.items()}
+        self._registry = {k: dict(v) for k, v in other._registry.items()}

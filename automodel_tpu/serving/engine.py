@@ -151,6 +151,10 @@ class ServingConfig:
     replicas: Optional[int] = None           # None -> 1 (single engine)
     router_policy: Optional[str] = None      # None -> round_robin
     fleet_probation_polls: Optional[int] = None   # None -> default (3)
+    # -- multi-tenant adapters (docs/guides/serving.md "Multi-tenant") -----
+    max_adapters: Optional[int] = None       # None -> multi-LoRA off
+    adapter_rank: Optional[int] = None       # None -> default (8)
+    tenant_quota: Optional[int] = None       # None -> no per-tenant cap
 
     def __post_init__(self):
         for field in ("kv_block_size", "max_num_seqs", "max_model_len",
@@ -167,7 +171,8 @@ class ServingConfig:
 
         for field in ("max_waiting", "max_preemptions", "sjf_aging_steps",
                       "replicas", "fleet_probation_polls",
-                      "prefix_lru_blocks", "spec_k"):
+                      "prefix_lru_blocks", "spec_k", "max_adapters",
+                      "adapter_rank", "tenant_quota"):
             v = normalize_null_spelling(getattr(self, field))
             setattr(self, field, v)
             if v is None:
@@ -236,9 +241,11 @@ def build_serving_config(cfg: Any) -> ServingConfig:
 
 
 def _paged_step(model, block_size: int, quantized: bool, cow_enabled: bool,
+                adapters_enabled: bool,
                 params, pools,
                 input_ids, positions, slot_mapping, block_tables,
-                context_lens, last_col, cow_src, cow_dst):
+                context_lens, last_col, cow_src, cow_dst,
+                adapter_ids=None, adapter_slabs=None):
     """ONE traced program per step width: run any pending copy-on-write
     block forks, write this step's tokens into the paged cache, attend,
     and greedy-pick EVERY column's next token.  Returns ``(greedy [B, W],
@@ -256,13 +263,27 @@ def _paged_step(model, block_size: int, quantized: bool, cow_enabled: bool,
     ``cow_enabled`` is a TRACE-TIME constant: with the prefix cache off
     no fork can ever be scheduled, so the step compiles without the
     per-step block copy (the cache-off path pays nothing; the args stay
-    in the signature so both modes keep one census)."""
+    in the signature so both modes keep one census).
+
+    ``adapters_enabled`` is likewise a trace-time constant: a multi-tenant
+    engine appends ``adapter_ids [B]`` int32 (0 = base) and the device
+    adapter slabs to every step, and the forward routes each row's rank-r
+    delta through the grouped GEMM (``ops/lora_gmm.py``).  A base-only
+    engine passes NEITHER — its traced program is the pre-multi-tenant
+    one, byte-identical.  Swapping a slot only changes slab CONTENTS, so
+    hot-swap never adds a program shape."""
     if cow_enabled:
         pools = cow_copy_blocks(pools, cow_src, cow_dst)
     view = PagedKVView(
         pools, block_tables, slot_mapping, context_lens, positions,
         block_size=block_size, quantized=quantized)
-    out = model(params, input_ids, position_ids=positions, kv_cache=view)
+    if adapters_enabled:
+        out = model(params, input_ids, position_ids=positions,
+                    kv_cache=view, adapters=adapter_slabs,
+                    adapter_ids=adapter_ids)
+    else:
+        out = model(params, input_ids, position_ids=positions,
+                    kv_cache=view)
     logits = out["logits"].astype(jnp.float32)                # [B, W, V]
     last = jnp.take_along_axis(
         logits, last_col[:, None, None], axis=1)[:, 0]        # [B, V]
@@ -313,6 +334,17 @@ class DecodeEngine:
             self.prefix_index = PrefixIndex(
                 self.allocator, block_size=self.config.kv_block_size,
                 lru_blocks=self.config.prefix_lru_blocks)
+        # -- multi-tenant adapter slots (serving/adapters.py) --------------
+        self.adapter_slots = None
+        if self.config.max_adapters:
+            from automodel_tpu.serving.adapters import (
+                DEFAULT_ADAPTER_RANK,
+                AdapterSlots,
+            )
+
+            self.adapter_slots = AdapterSlots(
+                model, max_adapters=self.config.max_adapters,
+                rank=self.config.adapter_rank or DEFAULT_ADAPTER_RANK)
         # -- speculative decoding (serving/speculative.py) -----------------
         spec_mode = self.config.speculative or DEFAULT_SPECULATIVE
         self.spec_k = self.config.spec_k or DEFAULT_SPEC_K
@@ -341,6 +373,8 @@ class DecodeEngine:
             prefix_index=self.prefix_index,
             spec_proposer=build_proposer(spec_mode),
             spec_k=self.spec_k,
+            tenant_quota=self.config.tenant_quota,
+            multi_tenant=self.adapter_slots is not None,
             clock=clock)
         self.requests: Dict[int, Request] = {}
         self.rejections: List[RequestRejected] = []
@@ -365,7 +399,8 @@ class DecodeEngine:
             fn = jax.jit(
                 functools.partial(_paged_step, self.model,
                                   self.config.kv_block_size, self.quantized,
-                                  self.prefix_index is not None),
+                                  self.prefix_index is not None,
+                                  self.adapter_slots is not None),
                 donate_argnums=(1,))
             self._steps[width] = fn
         return fn
@@ -385,11 +420,21 @@ class DecodeEngine:
                 out_shardings=self.param_sharding)
         return self._sync_copy(params)
 
-    def update_params(self, params) -> None:
+    def update_params(self, params=None, *, adapter_slot: Optional[int] = None,
+                      adapters=None, adapter_name: Optional[str] = None,
+                      adapter_scale: float = 1.0) -> None:
         """Adopt LIVE training params — the explicit weight-handoff API
         the post-training rollout layer drives (``post_training/
         rollout.py``; ``docs/guides/post_training.md`` "The weight-handoff
         contract").
+
+        **Per-slot adapter hot-swap arm** (multi-tenant serving): pass
+        ``adapter_slot``/``adapters`` (and nothing, or additionally the
+        base ``params``) to load or swap ONE tenant's LoRA tree into a
+        slot with zero downtime — digest-verified through the replication
+        shard protocol, committed atomically (``serving/adapters.py``),
+        and compile-stable: slab shapes never change, so no decode step
+        recompiles and rows on other slots are never perturbed.
 
         * **Device-to-device**: when the engine was built with a
           ``param_sharding`` pytree (its decode plan), the incoming tree —
@@ -408,6 +453,15 @@ class DecodeEngine:
           preemption semantics already tolerate that; rollout drivers
           sync only between generations).
         """
+        if adapter_slot is not None:
+            self.load_adapter(adapter_slot, adapters, name=adapter_name,
+                              scale=adapter_scale)
+            if params is None:
+                return
+        if params is None:
+            raise ValueError(
+                "update_params: pass base params, an adapter_slot swap, "
+                "or both")
         structs = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
         try:
@@ -427,11 +481,35 @@ class DecodeEngine:
         self.params = params
         self.weight_syncs += 1
 
+    # -- multi-tenant adapter slots (serving/adapters.py) -------------------
+    def _require_adapters(self):
+        if self.adapter_slots is None:
+            raise ValueError(
+                "this engine serves the base model only — set "
+                "serving.max_adapters to enable multi-tenant adapters")
+        return self.adapter_slots
+
+    def load_adapter(self, slot: int, adapters, *,
+                     name: Optional[str] = None,
+                     scale: float = 1.0) -> Dict[str, Any]:
+        """Load or hot-swap one tenant's LoRA tree into ``slot`` (1-based;
+        0 is the base model).  Raises ``AdapterLoadError`` on any
+        verification failure with the slot still serving its previous
+        adapter.  In-flight requests never notice: the next step simply
+        reads the new slab contents, same compiled program."""
+        return self._require_adapters().load(slot, adapters, name=name,
+                                             scale=scale)
+
+    def remove_adapter(self, slot: int) -> None:
+        """Unload ``slot``; later submits naming it are rejected."""
+        self._require_adapters().remove(slot)
+
     # -- request intake ----------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = "default",
                deadline_s: Optional[float] = None,
-               max_queue_s: Optional[float] = None) -> int:
+               max_queue_s: Optional[float] = None,
+               adapter_id: int = 0) -> int:
         """Queue one request; returns its id.  ``eos_token_id`` defaults to
         the engine's :class:`GenerationConfig` (pass None to disable).
 
@@ -446,13 +524,19 @@ class DecodeEngine:
             raise ValueError("cannot serve an empty prompt")
         if eos_token_id == "default":
             eos_token_id = self.generation.eos_token_id
+        if adapter_id != 0:
+            if not self._require_adapters().is_loaded(adapter_id):
+                raise ValueError(
+                    f"adapter_id={adapter_id} names an empty slot — load "
+                    "it first (engine.load_adapter)")
         rid = next(self._rids)
         req = Request(
             rid=rid, prompt=prompt,
             max_new_tokens=(self.generation.max_new_tokens
                             if max_new_tokens is None else max_new_tokens),
             eos_token_id=eos_token_id,
-            deadline_s=deadline_s, max_queue_s=max_queue_s)
+            deadline_s=deadline_s, max_queue_s=max_queue_s,
+            adapter_id=int(adapter_id))
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         self.submit_request(req)
@@ -524,6 +608,9 @@ class DecodeEngine:
         for work in plan.active:
             b = work.req.slot
             # draft tokens are ordinary written tokens to the device step:
+            # (adapter routing is assembled separately — see
+            # ``_assemble_adapter_ids`` — so this 8-tuple, and every
+            # base-only caller that splats it into the step, is unchanged)
             # same ids/pos/slot treatment, context covers them, and the
             # per-column argmax at their positions is the verify readout.
             # Only the HOST distinguishes pending from draft (acceptance
@@ -543,6 +630,15 @@ class DecodeEngine:
             if work.cow is not None:
                 cow_src[b], cow_dst[b] = work.cow
         return ids, pos, slots, tables, ctx, last, cow_src, cow_dst
+
+    def _assemble_adapter_ids(self, plan: StepPlan) -> np.ndarray:
+        """``[B]`` int32 slot routing for a multi-tenant step — idle rows
+        carry 0 (the base/zero adapter, a content no-op like the null
+        page), so adapter churn is data, never a shape."""
+        aids = np.zeros((self.config.max_num_seqs,), np.int32)
+        for work in plan.active:
+            aids[work.req.slot] = work.req.adapter_id
+        return aids
 
     def _sample(self, row: int, last_logits) -> int:
         # host-side sampling path (do_sample only — greedy rows read the
@@ -634,9 +730,15 @@ class DecodeEngine:
             # surfacing a timeout/cancellation) — the watchdog recovery
             # path must absorb it without crashing the engine loop.
             fault_point("serve_watchdog_stall")
+            # multi-tenant engines append the row->slot routing + the live
+            # slabs; base-only engines call with exactly the pre-multi-
+            # tenant ten args (their traced program is byte-unchanged)
+            extra = (() if self.adapter_slots is None
+                     else (self._assemble_adapter_ids(plan),
+                           self.adapter_slots.slabs))
             greedy, last_logits, self.pools = self.step_fn(plan.step_width)(
                 self.params, self.pools, ids, pos, slots, tables, ctx, last,
-                cow_src, cow_dst)
+                cow_src, cow_dst, *extra)
             # the engine's one host sync: the [B, W] per-column argmax
             # drives the host-side request state machine — plain decode
             # reads one column, the speculative verify reads k+1, SAME
@@ -823,6 +925,16 @@ class DecodeEngine:
             "draft_faults": sched.spec_draft_faults,
             "verify_failures": sched.spec_verify_failures,
         }
+        slots = self.adapter_slots
+        multi_tenant = {
+            "enabled": slots is not None,
+            "per_tenant": {k: dict(v)
+                           for k, v in sorted(sched.per_tenant.items())},
+        }
+        if slots is not None:
+            multi_tenant["adapters"] = slots.stats()
+            multi_tenant["tenant_quota"] = self.config.tenant_quota
+            multi_tenant["quota_deferrals"] = sched.tenant_quota_deferrals
         return {
             "prefill_tokens_saved": sched.prefix_tokens_reused,
             "cache_hit_rate": (idx.hits / max(1, idx.lookups)
@@ -834,6 +946,7 @@ class DecodeEngine:
             "tokens_per_step": (self.tokens_generated
                                 / max(1, self.steps_run)),
             "speculative": spec,
+            "multi_tenant": multi_tenant,
             "steps": self.steps_run,
             "decode_steps": self.decode_steps,
             "mixed_steps": self.mixed_steps,

@@ -215,27 +215,57 @@ class FleetRouter:
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = "default",
                deadline_s: Optional[float] = None,
-               max_queue_s: Optional[float] = None) -> int:
+               max_queue_s: Optional[float] = None,
+               adapter_id: int = 0) -> int:
         """Build one request and route it; returns its fleet-wide rid.
         Same intake contract as ``DecodeEngine.submit`` — a load drop is a
-        typed rejection in ``self.rejections``, never an exception."""
+        typed rejection in ``self.rejections``, never an exception.
+        ``adapter_id`` rides the request across any replica move (replay
+        re-prefills under the SAME adapter slot — every replica serves the
+        same slot registry, see :meth:`load_adapter`)."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("cannot serve an empty prompt")
         if eos_token_id == "default":
             eos_token_id = self.generation.eos_token_id
+        if adapter_id != 0:
+            alive = self.alive_replicas
+            if not alive or alive[0].engine.adapter_slots is None \
+                    or not alive[0].engine.adapter_slots.is_loaded(adapter_id):
+                raise ValueError(
+                    f"adapter_id={adapter_id} is not loaded on the fleet — "
+                    "load it first (FleetRouter.load_adapter)")
         rid = next(self._rids)
         req = Request(
             rid=rid, prompt=prompt,
             max_new_tokens=(self.generation.max_new_tokens
                             if max_new_tokens is None else max_new_tokens),
             eos_token_id=eos_token_id,
-            deadline_s=deadline_s, max_queue_s=max_queue_s)
+            deadline_s=deadline_s, max_queue_s=max_queue_s,
+            adapter_id=int(adapter_id))
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         self.requests[rid] = req
         self._route(req)
         return rid
+
+    # -- multi-tenant adapters ---------------------------------------------
+    def load_adapter(self, slot: int, adapters, *, name=None,
+                     scale: float = 1.0) -> Dict[int, Any]:
+        """Hot-swap ``slot`` on EVERY live replica (dead replicas pick the
+        registry up at admission by cloning a live peer's slots).  All-or-
+        nothing is per replica: a replica that fails verification keeps
+        its old adapter and the error propagates after no slab on it was
+        touched."""
+        out = {}
+        for r in self.alive_replicas:
+            out[r.replica_id] = r.engine.load_adapter(
+                slot, adapters, name=name, scale=scale)
+        return out
+
+    def remove_adapter(self, slot: int) -> None:
+        for r in self.alive_replicas:
+            r.engine.remove_adapter(slot)
 
     def _queue_room(self, replica: Replica) -> bool:
         """Mirror of ``Scheduler.add``'s shed trigger: a replica whose
@@ -511,6 +541,10 @@ class FleetRouter:
                 timers=self.timers, param_sharding=self._param_sharding,
                 sample_seed=self._sample_seed)
             engine.update_params(jax.tree.map(jnp.asarray, tree))
+            if peer.engine.adapter_slots is not None:
+                # the admitted engine must serve the same tenants as its
+                # warm source: clone the peer's slot registry + slabs
+                engine.adapter_slots.clone_from(peer.engine.adapter_slots)
             # the warm-up timeline's last leg: compile the fresh engine's
             # step widths NOW, while it still has no traffic — admission
             # pays the compiles, not the first unlucky request routed
@@ -570,8 +604,20 @@ class FleetRouter:
         return n
 
     def stats(self) -> Dict[str, Any]:
+        # fleet-wide per-tenant aggregation: sum each adapter id's
+        # counters across replicas (a replayed request counts on every
+        # engine that admitted it — the replay cost is real work)
+        per_tenant: Dict[int, Dict[str, int]] = {}
+        for r in self.replicas:
+            for tid, d in r.engine.scheduler.per_tenant.items():
+                agg = per_tenant.setdefault(
+                    tid, {"submitted": 0, "admitted": 0, "finished": 0,
+                          "tokens": 0})
+                for k, v in d.items():
+                    agg[k] = agg.get(k, 0) + v
         return {
             "replicas": len(self.replicas),
+            "per_tenant": {k: per_tenant[k] for k in sorted(per_tenant)},
             "alive": len(self.alive_replicas),
             "router_policy": self.policy,
             "health_polls": self.health_polls,

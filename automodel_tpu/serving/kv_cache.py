@@ -275,11 +275,21 @@ class PrefixIndex:
         h.update(np.asarray(list(tokens), dtype=np.int64).tobytes())
         return h.hexdigest()
 
-    def chain_keys(self, tokens) -> List[str]:
-        """The hash-chain keys of every FULL block of ``tokens``."""
+    @staticmethod
+    def root_key(adapter_id: int = 0) -> Optional[str]:
+        """Chain root for a tenant.  LoRA on q/k/v changes KV content, so
+        chains must namespace by adapter: a shared-prefix hit across
+        tenants would be a cross-tenant KV leak.  Base-model traffic
+        (adapter 0) roots at ``None`` — its keys, and therefore its warm
+        index, are byte-identical to a pre-multi-tenant engine."""
+        return None if adapter_id == 0 else "adapter:%d" % int(adapter_id)
+
+    def chain_keys(self, tokens, adapter_id: int = 0) -> List[str]:
+        """The hash-chain keys of every FULL block of ``tokens``, rooted
+        in ``adapter_id``'s namespace."""
         bs = self.block_size
         keys: List[str] = []
-        parent: Optional[str] = None
+        parent: Optional[str] = self.root_key(adapter_id)
         for i in range(len(tokens) // bs):
             parent = self.chain_key(parent, tokens[i * bs:(i + 1) * bs])
             keys.append(parent)

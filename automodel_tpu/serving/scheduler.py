@@ -194,6 +194,11 @@ class Request:
     slot: Optional[int] = None     # step-buffer row while active
     arrival: int = 0               # admission-order tiebreak
     preemptions: int = 0
+    # -- multi-tenant serving ----------------------------------------------
+    # adapter slot this request decodes under (0 = base model); rides the
+    # step buffers as the [B] int32 routing vector and namespaces the
+    # request's prefix-cache hash chain
+    adapter_id: int = 0
     # -- robustness layer --------------------------------------------------
     deadline_s: Optional[float] = None   # end-to-end budget from submit
     max_queue_s: Optional[float] = None  # WAITING-time TTL
@@ -299,6 +304,8 @@ class Scheduler:
                  prefix_index: Optional[PrefixIndex] = None,
                  spec_proposer: Optional[Callable] = None,
                  spec_k: int = DEFAULT_SPEC_K,
+                 tenant_quota: Optional[int] = None,
+                 multi_tenant: bool = False,
                  clock: Callable[[], float] = time.monotonic):
         policy = validate_scheduler_policy(normalize_scheduler_policy(policy))
         shed_policy = validate_shed_policy(
@@ -336,6 +343,15 @@ class Scheduler:
         # chain key -> count of admitted requests about to commit it (the
         # deferral signal for concurrent identical prompts)
         self._inflight_keys: Dict[str, int] = {}
+        # -- multi-tenant serving -----------------------------------------
+        # ``tenant_quota`` caps CONCURRENT slot-holders per adapter id;
+        # ``multi_tenant`` gates the sjf tenant fair-share term (off, the
+        # sjf key is bit-identical to the single-tenant scheduler)
+        self.tenant_quota = tenant_quota
+        self.multi_tenant = multi_tenant
+        self.tenant_quota_deferrals = 0
+        # adapter id -> {"submitted","admitted","finished","tokens"}
+        self.per_tenant: Dict[int, Dict[str, int]] = {}
         # -- speculative decoding (serving/speculative.py) ----------------
         # proposer None == off; pure-decode steps then keep width 1 and
         # every spec branch below is dead code (spec-off bit-unchanged)
@@ -378,7 +394,7 @@ class Scheduler:
             # touch.  The pool-pressure machinery (preemption/parking)
             # still governs actual growth.
             cached = self.prefix_index.peek(
-                self.prefix_index.chain_keys(req.prompt))
+                self.prefix_index.chain_keys(req.prompt, req.adapter_id))
             worst -= max(0, cached - 1)
         if worst > self.allocator.num_blocks - 1:
             raise ValueError(
@@ -386,6 +402,7 @@ class Scheduler:
                 f"pool has {self.allocator.num_blocks - 1} — raise "
                 "serving.num_kv_blocks / max_model_len")
         self.prompt_tokens += len(req.prompt)
+        self._tenant(req)["submitted"] += 1
         req.arrival = self._arrivals
         self._arrivals += 1
         req.submit_time = self.clock()
@@ -554,12 +571,29 @@ class Scheduler:
             self._step_time_ewma = 0.5 * self._step_time_ewma + 0.5 * seconds
 
     # -- internals ---------------------------------------------------------
+    def _tenant(self, req: Request) -> Dict[str, int]:
+        d = self.per_tenant.get(req.adapter_id)
+        if d is None:
+            d = {"submitted": 0, "admitted": 0, "finished": 0, "tokens": 0}
+            self.per_tenant[req.adapter_id] = d
+        return d
+
+    def _tenant_active(self, adapter_id: int) -> int:
+        return sum(1 for r in self.active if r.adapter_id == adapter_id)
+
     def _policy_key(self, req: Request, now: float):
         if self.policy == "sjf":
             work = (len(req.pending) + req.max_new_tokens
                     - len(req.out_tokens))
             waited = self._ticks - req.submit_tick
             aged = work / (1.0 + waited / float(self.sjf_aging_steps))
+            if self.multi_tenant:
+                # tenant fair-share: a tenant already holding k slots sees
+                # its next request's effective work scaled by (1 + k), so
+                # under contention idle tenants admit first.  Uniform
+                # traffic (all one tenant) scales every key by the same
+                # factor — ordering, and base-only behavior, unchanged.
+                aged *= 1.0 + self._tenant_active(req.adapter_id)
             return (aged, req.remaining_budget(now), req.arrival)
         return req.arrival                                   # fcfs
 
@@ -680,7 +714,7 @@ class Scheduler:
         if idx is None or req.blocks or req.num_computed:
             return False         # cache off, or a replay already seeded/ran
         tokens = req.seq
-        keys = idx.chain_keys(tokens)
+        keys = idx.chain_keys(tokens, req.adapter_id)
         if not keys:
             return False
         cached = idx.peek(keys)
@@ -744,7 +778,7 @@ class Scheduler:
         idx = self.prefix_index
         if idx is None:
             return
-        keys = idx.chain_keys(req.seq)
+        keys = idx.chain_keys(req.seq, req.adapter_id)
         req.inflight_keys = [k for k in keys[req.committed_blocks:]
                              if not idx.has_key(k)]
         for k in req.inflight_keys:
@@ -772,7 +806,13 @@ class Scheduler:
         full = min(req.num_computed // bs, len(req.blocks))
         while req.committed_blocks < full:
             i = req.committed_blocks
-            key = idx.commit(req.chain_key, seq[i * bs:(i + 1) * bs],
+            # block 0 commits under the request's TENANT root, not the
+            # bare None parent — otherwise a cold non-base request would
+            # index its first block where base traffic can hit it (the
+            # cross-tenant KV leak chain_keys() namespacing guards against)
+            parent = (req.chain_key if req.committed_blocks
+                      else idx.root_key(req.adapter_id))
+            key = idx.commit(parent, seq[i * bs:(i + 1) * bs],
                              req.blocks[i])
             req.chain_key = key
             req.committed_blocks += 1
@@ -790,6 +830,14 @@ class Scheduler:
             free_slots = [i for i, r in enumerate(self.slots) if r is None]
             if not free_slots:
                 return
+            if (self.tenant_quota is not None
+                    and self._tenant_active(req.adapter_id)
+                    >= self.tenant_quota):
+                # per-tenant admission quota: this tenant already holds its
+                # share of slots — the request WAITS (no rejection, no
+                # expiry) and other tenants' rows admit past it
+                self.tenant_quota_deferrals += 1
+                continue
             if self._try_prefix_seed(req):
                 continue         # deferred: an admitted twin is prefilling
             min_prefill = self._min_prefill_s(req)
@@ -810,6 +858,7 @@ class Scheduler:
             req.state = RequestState.PREFILL
             req.was_admitted = True
             self.admissions += 1
+            self._tenant(req)["admitted"] += 1
             self._register_inflight(req)
             self.prefix_tokens_reused += req.num_computed
 
@@ -965,6 +1014,7 @@ class Scheduler:
             sampling_rows += 1
             appended_total += appended
             self.tokens_appended += appended
+            self._tenant(req)["tokens"] += appended
             if finish_reason is not None:
                 self.slots[req.slot] = None
                 req.slot = None
@@ -975,6 +1025,7 @@ class Scheduler:
                 req.state = RequestState.FINISHED
                 req.finish_reason = finish_reason
                 req.finish_time = self.clock()
+                self._tenant(req)["finished"] += 1
                 done.append(req)
             else:
                 req.state = RequestState.DECODE

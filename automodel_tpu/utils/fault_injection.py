@@ -207,6 +207,23 @@ Serving-fleet points (see ``serving/fleet.py``):
                       serving — never a crash, never a half-admitted
                       replica receiving traffic.
 
+Multi-tenant adapter points (see ``serving/adapters.py``):
+
+    adapter_load      in ``AdapterSlots.load``, at the top of a load into
+                      an EMPTY slot — the adapter transport/verification
+                      failing.  Contract: a typed AdapterLoadError, no
+                      slab byte written (the slot keeps serving the zero
+                      adapter, i.e. rejects at submit), every other
+                      slot's traffic is unaffected, and the failure is
+                      counted (``load_failures``).
+    adapter_swap      in ``AdapterSlots.load``, at the top of a hot-swap
+                      of an OCCUPIED slot — the swap breaking mid-batch.
+                      Contract: a typed AdapterLoadError, the slot keeps
+                      serving its OLD adapter, and in-flight requests —
+                      on this slot and every other — finish token-
+                      identically (the commit is atomic: all new slab
+                      arrays are built before any reference flips).
+
 Post-training rollout points (see ``post_training/rollout.py``):
 
     rollout_weight_sync
@@ -277,6 +294,8 @@ KNOWN_FAULT_POINTS = frozenset({
     "fleet_route",
     "fleet_replica_loss",
     "fleet_replica_admit",
+    "adapter_load",
+    "adapter_swap",
     "rollout_weight_sync",
     "rollout_engine_step",
     "reward_fn",

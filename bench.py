@@ -209,6 +209,19 @@ SECONDARY = {
     # ``BENCH_SPEC=0`` skips the leg (records null); ``BENCH_SPEC_K``
     # sets the draft depth (default 4).
     "speculative": [],
+    # ``multi_lora`` — _multi_lora_secondary_main: decode tokens/s for a
+    # MIXED batch round-robined over n_adapters in {1, 4, 16} tenants
+    # (rank-8 adapters routed per-row through the grouped-GEMM slabs,
+    # docs/guides/serving.md "Multi-tenant serving"), with _vs_baseline =
+    # mixed n=4 tok/s / base-only plain-engine tok/s (the price of the
+    # adapter delta GEMMs).  Extra secondary keys:
+    # multi_lora_n{1,4,16}_vs_serial — mixed-batch tok/s / serial
+    # per-tenant tok/s on the identical request set (the multi-tenant
+    # batching win: one batched step instead of n tenant-by-tenant
+    # drains).  Greedy parity vs merged single-adapter engines is tier-1;
+    # this leg is the wall-clock.  ``BENCH_MULTI_LORA=0`` skips the leg
+    # (records null).
+    "multi_lora": [],
     # ``elastic_serve`` — _elastic_serve_secondary_main: the serving
     # analogue of the elastic drill (docs/guides/serving.md "Elastic
     # fleet").  A seeded arrival trace through a 2-replica FleetRouter
@@ -888,6 +901,87 @@ def _speculative_secondary_main() -> None:
     }))
 
 
+def _multi_lora_secondary_main() -> None:
+    """Child process: decode tokens/s for a mixed multi-tenant batch over
+    n_adapters in {1, 4, 16} rank-8 LoRA slots.
+
+    Every request carries an adapter id round-robined over slots 1..n;
+    the decode step routes each row through its tenant's slab pair with
+    ONE grouped GEMM per projection (rows sorted by adapter id — the MoE
+    dispatch trick on the PR-4 gmm chain), so the mixed batch costs one
+    batched step, not n tenant-by-tenant drains.  _vs_baseline = mixed
+    n=4 tok/s / base-only plain-engine tok/s prices the adapter delta
+    GEMMs; multi_lora_n{n}_vs_serial = mixed tok/s / serial per-tenant
+    tok/s on the identical requests is the batching win.  Greedy parity
+    vs merged-weights single-adapter engines is tier-1 (this leg is the
+    wall-clock).  ``BENCH_MULTI_LORA=0`` skips.
+    """
+    if os.environ.get("BENCH_MULTI_LORA", "1") == "0":
+        raise SystemExit("BENCH_MULTI_LORA=0: multi-LoRA leg skipped")
+    from automodel_tpu.peft.lora import PeftConfig, adapter_slab_shapes
+
+    model, params = _serve_model()
+    n_req, max_new = (8, 8) if SMALL else (32, 16)
+    prompt_len, rank = 24, 8
+    rng = np.random.default_rng(0)
+    prompts = [[int(t) for t in rng.integers(1, 2000, prompt_len)]
+               for _ in range(n_req)]
+    shapes = adapter_slab_shapes(model, PeftConfig(dim=rank), 1)
+
+    def make_adapter():
+        return {path: {"A": 0.01 * rng.standard_normal(
+                           (a[0],) + a[2:]).astype(np.float32),
+                       "B": 0.01 * rng.standard_normal(
+                           (b[0],) + b[2:]).astype(np.float32)}
+                for path, (a, b) in shapes.items()}
+
+    def make_engine(n_adapters):
+        from automodel_tpu.generation import GenerationConfig
+        from automodel_tpu.serving import DecodeEngine, ServingConfig
+
+        eng = DecodeEngine(
+            model, params,
+            ServingConfig(kv_block_size=16, max_num_seqs=8,
+                          max_model_len=prompt_len + max_new,
+                          prefill_chunk=32,
+                          max_adapters=n_adapters, adapter_rank=rank),
+            generation=GenerationConfig(max_new_tokens=max_new))
+        for slot in range(1, n_adapters + 1):
+            eng.load_adapter(slot, make_adapter())
+        eng.submit(prompts[0])     # warm both step widths off the clock
+        eng.run()
+        return eng
+
+    def timed(eng, batches):
+        t0 = time.perf_counter()
+        for batch in batches:
+            for p, aid in batch:
+                eng.submit(p, adapter_id=aid)
+            eng.run()
+        return n_req * max_new / (time.perf_counter() - t0)
+
+    # base-only floor: the identical trace through a plain engine
+    base = _serve_engine(model, params, max_num_seqs=8,
+                         max_model_len=prompt_len + max_new,
+                         max_new_tokens=max_new)
+    base.submit(prompts[0])
+    base.run()
+    tps_base = timed(base, [[(p, 0) for p in prompts]])
+
+    out, tps4 = {}, None
+    for n in ([1, 4] if SMALL else [1, 4, 16]):
+        ids = [1 + i % n for i in range(n_req)]
+        tps_mixed = timed(make_engine(n), [list(zip(prompts, ids))])
+        serial = [[(p, a) for p, a in zip(prompts, ids) if a == t]
+                  for t in range(1, n + 1)]
+        tps_serial = timed(make_engine(n), serial)
+        out[f"multi_lora_n{n}_vs_serial"] = round(tps_mixed / tps_serial, 4)
+        if n == 4:
+            tps4 = tps_mixed
+    print(json.dumps({"tps": round(tps4, 1),
+                      "vs_baseline": round(tps4 / tps_base, 4), **out}))
+
+
 def _drive_arrival_trace(eng, prompts, arrivals, *, deadline_s=None,
                          max_queue_s=None):
     """Step an engine through a host-drawn arrival trace; returns
@@ -1391,6 +1485,8 @@ def _secondary_main(name: str) -> None:
         return _prefix_cache_secondary_main()
     if name == "speculative":
         return _speculative_secondary_main()
+    if name == "multi_lora":
+        return _multi_lora_secondary_main()
     if name == "elastic_serve":
         return _elastic_serve_secondary_main()
     if name == "grpo":

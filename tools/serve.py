@@ -32,12 +32,22 @@ through probation + live-peer-params admission.  The exit contract is
 unchanged: 0 only when every request FINISHED — a loss the fleet fails
 to absorb cannot pass CI.
 
+Multi-tenant serving (docs/guides/serving.md "Multi-tenant serving"):
+``--adapters N`` arms the adapter slot registry (overrides
+``serving.max_adapters``), loads N synthetic rank-r adapters into slots
+1..N, and round-robins every driven request over adapter ids 0..N — so
+the mixed batch exercises the grouped-GEMM multi-LoRA decode path plus
+base traffic in one drive.  ``--tenant Q`` caps concurrent slots per
+tenant (overrides ``serving.tenant_quota``).  The exit contract is
+unchanged: 0 only when every request FINISHED.
+
     python tools/serve.py --config examples/serve/tiny_llama_serve.yaml
     python tools/serve.py --config ... --requests 32 --kv-dtype int8
     python tools/serve.py --config ... --deadline-s 30 --watchdog-s 10
     python tools/serve.py --config ... --fault serve_watchdog_stall:3
     python tools/serve.py --config ... --eval --limit 16
     python tools/serve.py --config ... --replicas 2 --drill-loss-at 5
+    python tools/serve.py --config ... --adapters 4 --tenant 2
 """
 
 from __future__ import annotations
@@ -55,15 +65,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _drive(engine, prompts, *, deadline_s, max_queue_s, drain_grace_s,
-           handler) -> dict:
+           handler, adapter_ids=None) -> dict:
     """Submit every prompt and step to completion, draining on a trapped
     signal.  Returns {"wall_s": ..., "drained": bool}.  Carries the same
     stall bound as ``engine.run()``: a scheduler wedge is a loud
     RuntimeError, never a silent CI hang."""
     t0 = time.perf_counter()
     drained = False
-    for p in prompts:
-        engine.submit(p, deadline_s=deadline_s, max_queue_s=max_queue_s)
+    ids = adapter_ids or [0] * len(prompts)
+    for p, aid in zip(prompts, ids):
+        engine.submit(p, deadline_s=deadline_s, max_queue_s=max_queue_s,
+                      adapter_id=aid)
     from automodel_tpu.serving.kv_cache import blocks_needed
 
     max_steps = 64 + 8 * sum(
@@ -86,7 +98,7 @@ def _drive(engine, prompts, *, deadline_s, max_queue_s, drain_grace_s,
 
 
 def _drive_fleet(fleet, prompts, *, deadline_s, max_queue_s, drain_grace_s,
-                 handler) -> dict:
+                 handler, adapter_ids=None) -> dict:
     """The fleet-mode drive: same contract as :func:`_drive`, plus one
     fleet health poll per step (the loop IS the health-poll cadence an
     operator deployment would run) and automatic grow-back: once a drill
@@ -94,8 +106,10 @@ def _drive_fleet(fleet, prompts, *, deadline_s, max_queue_s, drain_grace_s,
     through probation and the live-peer-params admission."""
     t0 = time.perf_counter()
     drained = False
-    for p in prompts:
-        fleet.submit(p, deadline_s=deadline_s, max_queue_s=max_queue_s)
+    ids = adapter_ids or [0] * len(prompts)
+    for p, aid in zip(prompts, ids):
+        fleet.submit(p, deadline_s=deadline_s, max_queue_s=max_queue_s,
+                     adapter_id=aid)
     from automodel_tpu.serving.kv_cache import blocks_needed
 
     max_steps = 64 + 8 * sum(
@@ -172,6 +186,14 @@ def main(argv=None) -> int:
                     help="arm fleet_replica_loss on the Nth health poll "
                          "(the drive loop polls once per step); implies "
                          "fleet mode")
+    ap.add_argument("--adapters", type=int, default=None,
+                    help="override serving.max_adapters, load that many "
+                         "synthetic LoRA adapters into slots 1..N, and "
+                         "round-robin requests over adapter ids 0..N "
+                         "(multi-tenant grouped-GEMM decode)")
+    ap.add_argument("--tenant", type=int, default=None,
+                    help="override serving.tenant_quota (max concurrent "
+                         "engine slots per adapter id)")
     ap.add_argument("--fault", default=None,
                     help="arm a fault-injection spec for CI drills, e.g. "
                          "'serve_block_alloc:3,serve_watchdog_stall:5'")
@@ -206,7 +228,9 @@ def main(argv=None) -> int:
                          ("shed_policy", "serving.shed_policy"),
                          ("drain_grace_s", "serving.drain_grace_s"),
                          ("replicas", "serving.replicas"),
-                         ("router_policy", "serving.router_policy")):
+                         ("router_policy", "serving.router_policy"),
+                         ("adapters", "serving.max_adapters"),
+                         ("tenant", "serving.tenant_quota")):
         v = getattr(args, flag)
         if v is not None:
             cfg.set_by_dotted(dotted, v)
@@ -244,10 +268,30 @@ def main(argv=None) -> int:
                               timers=timers)
     vocab = model.config.vocab_size
     rng = np.random.default_rng(args.seed)
+    n_adapters = args.adapters or 0
+    if n_adapters:
+        # synthetic tenants: one rank-r adapter per slot, loaded through
+        # the digest-verified hot-swap path the production loader uses
+        from automodel_tpu.peft.lora import PeftConfig, adapter_slab_shapes
+
+        slots = (engine.replicas[0].engine if fleet_mode
+                 else engine).adapter_slots
+        shapes = adapter_slab_shapes(
+            model, PeftConfig(dim=slots.rank), 1)
+        for slot in range(1, n_adapters + 1):
+            tree = {
+                path: {"A": 0.01 * rng.standard_normal(
+                           (a[0],) + a[2:]).astype(np.float32),
+                       "B": 0.01 * rng.standard_normal(
+                           (b[0],) + b[2:]).astype(np.float32)}
+                for path, (a, b) in shapes.items()}
+            engine.load_adapter(slot, tree, name=f"tenant-{slot}")
     prompts = [rng.integers(1, vocab, int(n)).tolist()
                for n in rng.integers(
                    4, max(5, scfg.max_model_len - gen.max_new_tokens),
                    args.requests)]
+    # mixed-tenant traffic: round-robin over base (0) + every loaded slot
+    adapter_ids = [i % (n_adapters + 1) for i in range(len(prompts))]
     # warm compiles off the clock (fleet: one request per replica so every
     # engine's step widths are compiled before traffic)
     for _ in range(len(engine.replicas) if fleet_mode else 1):
@@ -262,7 +306,8 @@ def main(argv=None) -> int:
                          max_queue_s=args.max_queue_s,
                          drain_grace_s=args.drain_grace_s
                          if args.drain_grace_s is not None
-                         else scfg.drain_grace_s, handler=h)
+                         else scfg.drain_grace_s, handler=h,
+                         adapter_ids=adapter_ids)
     if fault_spec:
         fi.reset_faults()
     stats = engine.stats()
